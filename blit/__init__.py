@@ -1,0 +1,39 @@
+"""blit — TPU-native Breakthrough Listen distributed data-product framework.
+
+A brand-new, TPU-first (JAX/XLA/Pallas/pjit) framework with the capabilities of
+the reference package ``BLDistributedDataProducts.jl`` (see ``SURVEY.md``):
+distributed discovery, access, and reduction of Breakthrough Listen datasets
+recorded across the BL@GBT cluster's ``(band, bank)`` node topology.
+
+Layer map (mirrors SURVEY.md §1, rebuilt TPU-first):
+
+- ``blit.gbt``       — main-process orchestration API (reference: src/gbt.jl).
+- ``blit.workers``   — per-worker access functions (reference:
+  src/gbtworkerfunctions.jl), host-side Python.
+- ``blit.io``        — SIGPROC filterbank / FBH5 / GUPPI RAW codecs (reference:
+  Blio.jl + HDF5.jl + H5Zbitshuffle.jl dependency layer).
+- ``blit.ops``       — JAX/Pallas compute: fqav, kurtosis, despike, dequant,
+  PFB channelizer, large staged FFT, Stokes detect.
+- ``blit.parallel``  — the (band, bank) ``jax.sharding.Mesh``, worker pools,
+  all_gather band stitching, psum beamforming, FX correlation.
+- ``blit.pipeline``  — GUPPI RAW → high-resolution filterbank reduction driver.
+"""
+
+from blit.version import __version__
+
+__all__ = ["__version__"]
+
+
+def __getattr__(name):
+    # Lazy submodule access (keeps `import blit` light; JAX-dependent modules
+    # only load when touched).
+    if name in ("gbt", "workers", "io", "ops", "parallel", "pipeline"):
+        import importlib
+
+        try:
+            return importlib.import_module(f"blit.{name}")
+        except ImportError as e:
+            # PEP 562: attribute access must surface AttributeError (e.g. so
+            # hasattr() works), not ModuleNotFoundError.
+            raise AttributeError(f"module 'blit' has no attribute {name!r}") from e
+    raise AttributeError(f"module 'blit' has no attribute {name!r}")

@@ -1,0 +1,79 @@
+"""Site configuration with BL@GBT defaults.
+
+The reference scatters its site defaults across keyword arguments
+(``root="/datax/dibas"``, ``extra="GUPPI"``, regexes — src/gbt.jl:48-53;
+ssh options — src/gbt.jl:12-18).  Here they live in one dataclass, and every
+API function accepts an optional ``config=`` override (SURVEY.md §5 "Config").
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import List, Optional, Pattern, Tuple
+
+from blit import naming
+
+
+def datahosts(prefix: str = "") -> List[str]:
+    """The 64 default BL@GBT host names ``blc00``..``blc77`` — 8 racks
+    (bands) x 8 nodes (banks), optionally prefixed for ssh aliases.
+
+    Reference: ``GBT.datahosts`` (src/gbt.jl:8-10).
+    """
+    return [f"{prefix}blc{band}{bank}" for band in range(8) for bank in range(8)]
+
+
+# GBT BL backend constants (reference: README.md:17-27, src/gbtworkerfunctions.jl:134)
+BAND_MHZ = 1500.0          # one band (8 banks) covers a 1500 MHz IF signal
+BANK_MHZ = BAND_MHZ / 8    # each bank owns a contiguous 187.5 MHz slice
+COARSE_PER_BANK = 64       # coarse channels recorded per bank (src/gbt.jl:101)
+COARSE_MHZ = BANK_MHZ / COARSE_PER_BANK  # ~2.93 MHz coarse channel width
+
+
+def nfpc_from_foff(foff_mhz: float) -> int:
+    """Fine channels per coarse channel implied by a filterbank's channel
+    width: ``round(187.5/64/|foff|)`` (reference: src/gbtworkerfunctions.jl:134).
+    Returned as int; reference stores Int32 for FBH5 parity."""
+    return int(round(COARSE_MHZ / abs(foff_mhz)))
+
+
+@dataclass
+class SiteConfig:
+    """Everything site-specific, with BL@GBT defaults.
+
+    Reference keyword defaults: src/gbt.jl:48-53 (inventory) and
+    src/gbt.jl:12-18 (worker bring-up).
+    """
+
+    root: str = "/datax/dibas"
+    extra: str = "GUPPI"
+    session_re: Pattern = naming.SESSION_RE
+    player_re: Pattern = naming.PLAYER_RE
+    file_re: Pattern = naming.DEFAULT_FILE_RE
+    # hosts=None derives the default 64-host list from host_prefix (the
+    # reference's `prefix` ssh-alias kwarg, src/gbt.jl:14).
+    hosts: Optional[List[str]] = None
+    host_prefix: str = ""
+    # Logical mesh shape (bands, banks) mapped onto the TPU device mesh.
+    mesh_shape: Tuple[int, int] = (8, 8)
+    # Worker-pool backend: "local" | "thread" | "process" (plugin boundary per
+    # BASELINE.json: a backend flag swaps the worker pool implementation).
+    backend: str = "thread"
+
+    def __post_init__(self):
+        if self.hosts is None:
+            self.hosts = datahosts(self.host_prefix)
+
+    def with_(self, **kw) -> "SiteConfig":
+        from dataclasses import replace
+
+        return replace(self, **kw)
+
+
+DEFAULT = SiteConfig()
+
+
+def _compile(p) -> Pattern:
+    """Accept str or compiled pattern for all regex-valued options."""
+    return re.compile(p) if isinstance(p, str) else p

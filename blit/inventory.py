@@ -1,0 +1,134 @@
+"""On-node inventory crawl of GUPPI-convention directory trees.
+
+Reference: ``WorkerFunctions.getinventory`` + ``InventoryTuple``
+(src/gbtworkerfunctions.jl:63-129).  The crawl walks
+``<root>/<session>/<extra>/<player>/**``: top-level session directories
+(symlinks to directories included) filtered by ``session_re``, player
+directories filtered by ``player_re``, then a recursive walk per player with
+files filtered by ``file_re``; each hit is parsed with
+:func:`blit.naming.parse_guppi_name`, warning and skipping on mismatch.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+from typing import Iterable, List, NamedTuple, Optional, Pattern, Union
+
+from blit import naming
+from blit.config import DEFAULT, SiteConfig, _compile
+
+log = logging.getLogger("blit.inventory")
+
+
+class InventoryRecord(NamedTuple):
+    """One data-product file found on one host.
+
+    Field names, order, and types match the reference ``InventoryTuple``
+    (src/gbtworkerfunctions.jl:63-66; README.md:77-89) so downstream tabular
+    workflows (pandas ``DataFrame(records)``, groupby on (session, scan))
+    carry over unchanged.
+    """
+
+    imjd: int
+    smjd: int
+    session: str
+    scan: str
+    src_name: str
+    band: int
+    bank: int
+    host: str
+    file: str
+    worker: int
+
+
+def _listdirs(path: str) -> List[str]:
+    """Names of subdirectories of `path`, *including* symlinks that resolve to
+    directories (reference includes session symlinks: src/gbtworkerfunctions.jl:81-83).
+    Sorted for determinism (Julia's walkdir sorts by name)."""
+    try:
+        with os.scandir(path) as it:
+            names = [e.name for e in it if e.is_dir(follow_symlinks=True)]
+    except OSError:
+        return []
+    return sorted(names)
+
+
+def get_inventory(
+    file_re: Union[str, Pattern, None] = None,
+    *,
+    root: Optional[str] = None,
+    session_re: Union[str, Pattern, None] = None,
+    extra: Optional[str] = None,
+    player_re: Union[str, Pattern, None] = None,
+    worker: int = 0,
+    host: Optional[str] = None,
+    config: SiteConfig = DEFAULT,
+) -> List[InventoryRecord]:
+    """Crawl this host's data tree and return its inventory.
+
+    Matches reference behavior (src/gbtworkerfunctions.jl:68-129):
+
+    - returns ``[]`` early if ``root`` is not a directory;
+    - session symlinks are followed;
+    - files whose *basename* matches ``file_re`` are parsed against the full
+      path; parse failures log a warning and are skipped (per-file
+      warn-and-skip is the reference's only "fault tolerance" — SURVEY.md §5);
+    - ``host``/``worker`` are stamped into every record.
+    """
+    file_re = _compile(file_re) if file_re is not None else config.file_re
+    session_re = _compile(session_re) if session_re is not None else config.session_re
+    player_re = _compile(player_re) if player_re is not None else config.player_re
+    root = root if root is not None else config.root
+    extra = extra if extra is not None else config.extra
+    host = host or socket.gethostname()
+
+    records: List[InventoryRecord] = []
+    if not os.path.isdir(root):
+        return records
+
+    sessions = [s for s in _listdirs(root) if session_re.search(s)]
+    for session in sessions:
+        playerdir = os.path.join(root, session, extra)
+        players = [p for p in _listdirs(playerdir) if player_re.search(p)]
+        for player in players:
+            top = os.path.join(playerdir, player)
+            for dirpath, dirnames, filenames in os.walk(top):
+                dirnames.sort()
+                for base in sorted(filenames):
+                    if not file_re.search(base):
+                        continue
+                    path = os.path.join(dirpath, base)
+                    parsed = naming.parse_guppi_name(path)
+                    if parsed is None:
+                        log.warning("%s:%s did not match guppiname regex", host, path)
+                        continue
+                    if parsed.band is None or parsed.bank is None:
+                        log.warning("%s:%s did not match player regex", host, path)
+                        continue
+                    records.append(
+                        InventoryRecord(
+                            imjd=parsed.imjd,
+                            smjd=parsed.smjd,
+                            session=session,
+                            scan=parsed.scan,
+                            src_name=parsed.src,
+                            band=parsed.band,
+                            bank=parsed.bank,
+                            host=host,
+                            file=path,
+                            worker=worker,
+                        )
+                    )
+    return records
+
+
+def to_dataframe(inventories: Iterable[Iterable[InventoryRecord]]):
+    """Flatten per-worker inventories into one pandas DataFrame — the L4
+    analysis-layer workflow from the reference README
+    (``DataFrame(Iterators.flatten(invs))``, README.md:95-157)."""
+    import pandas as pd
+
+    flat = [rec for inv in inventories for rec in inv]
+    return pd.DataFrame(flat, columns=InventoryRecord._fields)

@@ -1,0 +1,141 @@
+"""GUPPI / rawspec file-name and directory-name parsing.
+
+Reference semantics: ``/root/reference/src/gbtworkerfunctions.jl:35-61`` (the
+``parseguppiname`` / ``parserawspecname`` verbose regexes) and the session /
+player directory regexes at ``src/gbt.jl:50-52``.
+
+Two reference warts are deliberately *fixed* here (SURVEY.md §2.1):
+
+- The reference player regex ``r"^BLP([?<band>0-7])(?<bank>[0-7])$"`` contains a
+  malformed named group — the first "group" is really the character class
+  ``[?<band>0-7]``, so junk like ``BLPd3`` is accepted.  The corrected regex
+  ``^BLP(?P<band>[0-7])(?P<bank>[0-7])$`` is used.
+- All dots in literal suffixes (``.rawspec.``, ``.h5``) are escaped.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Optional
+
+# A session is a GBT project ID + session ID, e.g. "AGBT22B_999_01"
+# (reference: src/gbt.jl:50, src/gbtworkerfunctions.jl:70).
+SESSION_RE = re.compile(r"[AT]GBT[12][0-9][AB]_\d+_\d+")
+
+# A player directory names the logical recording node "BLP<band><bank>"
+# (reference: src/gbt.jl:52 — corrected; see module docstring).
+PLAYER_RE = re.compile(r"^BLP(?P<band>[0-7])(?P<bank>[0-7])$")
+
+# Default inventory file pattern: the low-resolution rawspec product
+# (reference: src/gbt.jl:48).
+DEFAULT_FILE_RE = re.compile(r"0002\.h5$")
+
+# The /BLP<band><bank>/ path component, searched anywhere in the path.  The
+# reference's single regex allows at most one intermediate path component
+# between /BLPbb/ and the file (src/gbtworkerfunctions.jl:38), silently losing
+# band/bank for deeper nesting; parsing the path component-wise removes that
+# limitation while keeping band/bank semantics identical.
+PLAYER_COMPONENT_RE = re.compile(r"/BLP(?P<band>[0-7])(?P<bank>[0-7])/")
+
+# GUPPI-convention file basename, e.g.
+#   blc42_guppi_59897_21221_HD_84406_0011.rawspec.0002.h5
+# (reference: src/gbtworkerfunctions.jl:35-47).  Like Julia's `match`, this is
+# searched (unanchored); the host prefix and the numeric field between smjd
+# and source name are optional.
+GUPPI_BASE_RE = re.compile(
+    r"""
+    (?:(?P<host>blc..)_)?
+    guppi_
+    (?P<imjd>\d+)_
+    (?P<smjd>\d+)_
+    (?:\d+_)?
+    (?P<src>.*)_
+    (?P<scan>\d{4})
+    """,
+    re.VERBOSE,
+)
+
+# Stricter basename variant that additionally captures the rawspec product
+# number and requires a ".rawspec.NNNN.h5|fil" suffix (reference:
+# src/gbtworkerfunctions.jl:49-61; defined there but never called — kept
+# public here for user code, as in the reference).
+RAWSPEC_BASE_RE = re.compile(
+    GUPPI_BASE_RE.pattern
+    + r"""
+    \.rawspec\.
+    (?P<product>\d{4})
+    \.(?:h5|fil)$
+    """,
+    re.VERBOSE,
+)
+
+
+@dataclass(frozen=True)
+class GuppiName:
+    """Parsed components of a GUPPI-convention file path.
+
+    ``band``/``bank``/``host`` are None when the path lacks the optional
+    ``/BLP<band><bank>/`` component or ``blc??_`` host prefix.  ``product`` is
+    only set when parsed by :func:`parse_rawspec_name`.
+    """
+
+    imjd: int
+    smjd: int
+    src: str
+    scan: str
+    band: Optional[int] = None
+    bank: Optional[int] = None
+    host: Optional[str] = None
+    product: Optional[str] = None
+
+
+def _parse(name: str, base_re: re.Pattern, require_player: bool) -> Optional[GuppiName]:
+    base = name.rsplit("/", 1)[-1]
+    m = base_re.search(base)
+    if m is None:
+        return None
+    # Rightmost /BLPbb/ component: the player dir sits closest to the file,
+    # so a BLP-like component higher up (e.g. in the root path) must not
+    # shadow it.
+    pm = None
+    for pm in PLAYER_COMPONENT_RE.finditer(name):
+        pass
+    if require_player and pm is None:
+        return None
+    g = m.groupdict()
+    return GuppiName(
+        imjd=int(g["imjd"]),
+        smjd=int(g["smjd"]),
+        src=g["src"],
+        scan=g["scan"],
+        band=int(pm.group("band")) if pm else None,
+        bank=int(pm.group("bank")) if pm else None,
+        host=g.get("host"),
+        product=g.get("product"),
+    )
+
+
+def parse_guppi_name(name: str) -> Optional[GuppiName]:
+    """Parse a GUPPI-convention path; None if it doesn't match.
+
+    Handles both raw voltage files (``*.NNNN.raw``) and rawspec products
+    (``*.rawspec.NNNN.{h5,fil}``), matching the reference ``parseguppiname``
+    (src/gbtworkerfunctions.jl:35-47).  ``band``/``bank`` come from the
+    ``/BLP<band><bank>/`` path component when present, at any depth.
+    """
+    return _parse(name, GUPPI_BASE_RE, require_player=False)
+
+
+def parse_rawspec_name(name: str) -> Optional[GuppiName]:
+    """Parse a rawspec product path, requiring the ``/BLPbb/`` path component
+    and ``.rawspec.NNNN.{h5,fil}`` suffix (src/gbtworkerfunctions.jl:49-61)."""
+    return _parse(name, RAWSPEC_BASE_RE, require_player=True)
+
+
+def player_name(band: int, bank: int) -> str:
+    """The logical recording-node name ``BLP<band><bank>``
+    (reference: README.md:21-23)."""
+    if not (0 <= band <= 7 and 0 <= bank <= 7):
+        raise ValueError(f"band and bank must be in 0..7, got {band},{bank}")
+    return f"BLP{band}{bank}"

@@ -1,0 +1,6 @@
+"""blit.ops — compute kernels (NumPy host path + JAX/Pallas TPU path)."""
+
+from blit.ops.fqav import fqav, fqav_range
+from blit.ops.stats import kurtosis
+
+__all__ = ["fqav", "fqav_range", "kurtosis"]

@@ -1,0 +1,56 @@
+"""Frequency averaging (``fqav``) with reference semantics.
+
+Reference: ``WorkerFunctions.fqav`` (src/gbtworkerfunctions.jl:16-33).
+
+Array-layout note (important for parity): the reference indexes filterbank
+arrays ``(channel, pol, time)`` in column-major Julia, so *channel is the
+fastest-varying axis*.  blit's canonical layout is the natural C-order read of
+the same files: ``(time, pol, channel)`` with channel again fastest-varying —
+identical memory semantics, transposed indexing.  ``fqav`` therefore reduces
+groups of ``n`` along the *last* axis here, where the reference reduces along
+its first axis (``reshape(A, (n, :, ...)); reduce dims=1``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+
+def fqav(a, n: int, f: Callable = None):
+    """Reduce every ``n`` consecutive elements of the channel (last) axis of
+    ``a`` to a single value using reduction ``f`` (default: sum).
+
+    - ``n <= 1`` returns ``a`` unchanged (src/gbtworkerfunctions.jl:17).
+    - ``n`` must divide the channel count: the reference's ``reshape`` throws
+      otherwise (README.md:186-191); we raise ``ValueError``.
+    - ``f`` is any reduction accepting ``(array, axis=...)`` — e.g. ``np.sum``,
+      ``np.mean``, ``np.max``, ``jnp.sum``.  Works on NumPy and JAX arrays
+      alike (only ``reshape`` + the supplied reduction are used).
+    """
+    if n <= 1:
+        return a
+    nchan = a.shape[-1]
+    if nchan % n != 0:
+        raise ValueError(f"fqav: n={n} does not divide channel count {nchan}")
+    if f is None:
+        f = _default_sum
+    grouped = a.reshape(a.shape[:-1] + (nchan // n, n))
+    return f(grouped, axis=-1)
+
+
+def _default_sum(a, axis):
+    return a.sum(axis=axis)
+
+
+def fqav_range(fch1: float, foff: float, nchans: int, n: int) -> Tuple[float, float, int]:
+    """Frequency-*axis* averaging: the ``(fch1, foff, nchans)`` triple of the
+    channel axis after ``fqav`` by ``n``.
+
+    Reference: ``fqav(r::AbstractRange, n)`` (src/gbtworkerfunctions.jl:27-33):
+    new first frequency ``fch1 + (n-1)*foff/2`` (the mean of the first group),
+    step ``n*foff``, length ``nchans ÷ n``.  Always the mean, regardless of the
+    array reduction used (README.md:222-226).
+    """
+    if n <= 1:
+        return (fch1, foff, nchans)
+    return (fch1 + (n - 1) * foff / 2, n * foff, nchans // n)

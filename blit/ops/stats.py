@@ -1,0 +1,24 @@
+"""Sample statistics over the time axis.
+
+Reference: ``WorkerFunctions.getkurtosis`` (src/gbtworkerfunctions.jl:197-202)
+uses ``StatsBase.kurtosis`` = *excess* kurtosis with biased (divide-by-n)
+central moments (README.md:216-217).
+"""
+
+from __future__ import annotations
+
+
+def kurtosis(data, axis: int = 0):
+    """Excess kurtosis ``m4/m2**2 - 3`` with biased central moments, reduced
+    over ``axis`` (default: the time axis of a ``(time, pol, chan)`` array).
+
+    Works on NumPy and JAX arrays.  For the canonical 3-D layout the result
+    has shape ``(pol, chan)``; :func:`blit.workers.get_kurtosis` transposes to
+    ``(chan, pol)`` for reference indexing parity (src/gbtworkerfunctions.jl:201).
+    """
+    mu = data.mean(axis=axis, keepdims=True)
+    d = data - mu
+    d2 = d * d
+    m2 = d2.mean(axis=axis)
+    m4 = (d2 * d2).mean(axis=axis)
+    return m4 / (m2 * m2) - 3.0

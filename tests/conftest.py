@@ -1,0 +1,17 @@
+"""Test harness: run JAX on a virtual 8-device CPU mesh.
+
+Must set the XLA flags *before* jax is imported anywhere, so this executes at
+conftest import time.  This fakes the 8-bank (and 2x4 band,bank) topology the
+same way SURVEY.md §4 prescribes for testing the multi-chip path without
+multi-chip hardware.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

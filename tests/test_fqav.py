@@ -1,0 +1,69 @@
+"""fqav parity tests, including the reference's own two unit tests
+(test/runtests.jl:4-7) translated to the (fch1, foff, nchans) triple form."""
+
+import numpy as np
+import pytest
+
+from blit.ops import fqav, fqav_range
+
+
+def test_reference_range_tests():
+    # Julia: @test GBT.fqav(1:4, 4) === 2.5:4.0:2.5  (start 2.5, step 4, len 1)
+    assert fqav_range(1.0, 1.0, 4, 4) == (2.5, 4.0, 1)
+    # Julia: @test GBT.fqav(1:2:15, 4) === 4.0:8.0:12.0  (start 4, step 8, len 2)
+    assert fqav_range(1.0, 2.0, 8, 4) == (4.0, 8.0, 2)
+
+
+def test_range_identity():
+    assert fqav_range(10.0, -0.5, 64, 1) == (10.0, -0.5, 64)
+    assert fqav_range(10.0, -0.5, 64, 0) == (10.0, -0.5, 64)
+
+
+def test_range_negative_foff():
+    fch1, foff, n = fqav_range(100.0, -1.0, 8, 2)
+    assert (fch1, foff, n) == (99.5, -2.0, 4)
+
+
+def test_array_sum_default():
+    a = np.arange(12.0).reshape(1, 1, 12)
+    out = fqav(a, 4)
+    assert out.shape == (1, 1, 3)
+    np.testing.assert_allclose(out[0, 0], [0 + 1 + 2 + 3, 4 + 5 + 6 + 7, 8 + 9 + 10 + 11])
+
+
+def test_array_mean_and_max():
+    a = np.arange(8.0).reshape(1, 1, 8)
+    np.testing.assert_allclose(fqav(a, 2, f=np.mean)[0, 0], [0.5, 2.5, 4.5, 6.5])
+    np.testing.assert_allclose(fqav(a, 2, f=np.max)[0, 0], [1, 3, 5, 7])
+
+
+def test_array_identity_n1():
+    a = np.random.default_rng(0).normal(size=(5, 2, 8))
+    assert fqav(a, 1) is a
+    assert fqav(a, 0) is a
+
+
+def test_array_divisibility_error():
+    a = np.zeros((2, 2, 10))
+    with pytest.raises(ValueError):
+        fqav(a, 3)
+
+
+def test_array_3d_grouping_matches_reference_layout():
+    # Channel is the fastest-varying axis in both layouts; averaging groups
+    # consecutive channels.  Check against an explicit loop.
+    rng = np.random.default_rng(1)
+    a = rng.normal(size=(4, 2, 16))
+    out = fqav(a, 4)
+    expect = np.zeros((4, 2, 4))
+    for c in range(4):
+        expect[:, :, c] = a[:, :, 4 * c : 4 * c + 4].sum(axis=-1)
+    np.testing.assert_allclose(out, expect)
+
+
+def test_array_jax():
+    import jax.numpy as jnp
+
+    a = jnp.arange(12.0).reshape(1, 1, 12)
+    out = fqav(a, 3, f=jnp.sum)
+    np.testing.assert_allclose(np.asarray(out)[0, 0], [3, 12, 21, 30])

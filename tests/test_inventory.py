@@ -1,0 +1,80 @@
+"""Inventory crawl over a synthetic directory tree covering the reference's
+edge cases (src/gbtworkerfunctions.jl:68-129): symlinked sessions, regex
+filtering at every level, malformed names -> warn-and-skip, missing root."""
+
+import os
+
+from blit.inventory import InventoryRecord, get_inventory, to_dataframe
+
+
+def mkfile(path):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(b"x")
+
+
+def build_tree(root):
+    s1 = "AGBT22B_999_01"
+    s2 = "AGBT22B_999_02"
+    # session 1, two players, one matching file each + one non-matching product
+    for player, host in [("BLP00", "blc00"), ("BLP01", "blc01")]:
+        base = f"{root}/{s1}/GUPPI/{player}"
+        mkfile(f"{base}/{host}_guppi_59897_21221_HD_84406_0011.rawspec.0002.h5")
+        mkfile(f"{base}/{host}_guppi_59897_21221_HD_84406_0011.rawspec.0001.h5")
+    # a player dir that must be filtered out (bad name — reference's malformed
+    # regex would have accepted it; ours must not)
+    mkfile(f"{root}/{s1}/GUPPI/BLPd3/blc03_guppi_59897_21221_HD_84406_0011.rawspec.0002.h5")
+    # a non-session dir to be filtered
+    mkfile(f"{root}/junkdir/GUPPI/BLP00/blc00_guppi_1_2_X_0001.rawspec.0002.h5")
+    # a matching-name file whose guppi name doesn't parse -> warn-and-skip
+    mkfile(f"{root}/{s1}/GUPPI/BLP00/garbage.rawspec.0002.h5")
+    # session 2 as real dir, session 3 as symlink to it
+    mkfile(f"{root}/{s2}/GUPPI/BLP11/blc11_guppi_59898_100_VOYAGER1_0001.rawspec.0002.h5")
+    os.symlink(f"{root}/{s2}", f"{root}/AGBT22B_999_03")
+    return root
+
+
+def test_crawl(tmp_path, caplog):
+    root = build_tree(str(tmp_path))
+    with caplog.at_level("WARNING", logger="blit.inventory"):
+        inv = get_inventory(root=root, worker=5, host="testhost")
+    files = [os.path.basename(r.file) for r in inv]
+    # 2 from session1 + 1 from session2 + 1 via the session3 symlink
+    assert len(inv) == 4
+    assert all(f.endswith("0002.h5") for f in files)
+    # the malformed-name file triggered a warning and was skipped
+    assert any("garbage" in rec.message for rec in caplog.records)
+    # field stamping
+    assert all(r.host == "testhost" and r.worker == 5 for r in inv)
+    # band/bank parsed from the player path component
+    r0 = [r for r in inv if r.session == "AGBT22B_999_01"][0]
+    assert (r0.band, r0.bank) == (0, 0)
+    assert r0.scan == "0011"
+    assert r0.src_name == "HD_84406"
+    assert r0.imjd == 59897 and r0.smjd == 21221
+    # symlinked session appears under its own (symlink) session name
+    sessions = {r.session for r in inv}
+    assert sessions == {"AGBT22B_999_01", "AGBT22B_999_02", "AGBT22B_999_03"}
+
+
+def test_missing_root_returns_empty(tmp_path):
+    assert get_inventory(root=str(tmp_path / "nope")) == []
+
+
+def test_custom_file_re(tmp_path):
+    root = build_tree(str(tmp_path))
+    inv = get_inventory(r"0001\.h5$", root=root)
+    assert len(inv) == 2
+    assert all(r.file.endswith("0001.h5") for r in inv)
+
+
+def test_to_dataframe(tmp_path):
+    root = build_tree(str(tmp_path))
+    inv1 = get_inventory(root=root, worker=1)
+    inv2 = []  # ragged per-worker inventories are first-class
+    df = to_dataframe([inv1, inv2])
+    assert list(df.columns) == list(InventoryRecord._fields)
+    assert len(df) == 4
+    # the reference README's canonical groupby workflow (README.md:95-157)
+    g = df.groupby(["session", "scan"]).size()
+    assert g.loc[("AGBT22B_999_01", "0011")] == 2

@@ -1,0 +1,104 @@
+"""Name/path parsing parity with the reference regexes
+(src/gbtworkerfunctions.jl:35-61, src/gbt.jl:50-52)."""
+
+import pytest
+
+from blit import naming
+
+
+H5 = "/datax/dibas/AGBT22B_999_01/GUPPI/BLP42/blc42_guppi_59897_21221_HD_84406_0011.rawspec.0002.h5"
+RAW = "/datax/dibas/AGBT22B_999_01/GUPPI/BLP17/blc17_guppi_59897_21221_HD_84406_0011.0000.raw"
+
+
+def test_parse_guppi_h5():
+    p = naming.parse_guppi_name(H5)
+    assert p is not None
+    assert (p.band, p.bank) == (4, 2)
+    assert p.host == "blc42"
+    assert (p.imjd, p.smjd) == (59897, 21221)
+    assert p.src == "HD_84406"
+    assert p.scan == "0011"
+
+
+def test_parse_guppi_raw():
+    p = naming.parse_guppi_name(RAW)
+    assert p is not None
+    assert (p.band, p.bank) == (1, 7)
+    assert p.scan == "0011"
+    assert p.src == "HD_84406"
+
+
+def test_parse_guppi_no_player_component():
+    # band/bank path component and host prefix are both optional.
+    p = naming.parse_guppi_name("guppi_59897_21221_HD_84406_0011.rawspec.0002.h5")
+    assert p is not None
+    assert p.band is None and p.bank is None and p.host is None
+    assert p.imjd == 59897
+
+
+def test_parse_guppi_optional_numeric_field():
+    # The optional (\d+_)? between smjd and src (e.g. frequency tag).
+    p = naming.parse_guppi_name("/BLP00/guppi_59897_21221_12345_VOYAGER1_0002.0000.raw")
+    assert p is not None
+    assert p.src == "VOYAGER1"
+    assert p.scan == "0002"
+
+
+def test_parse_guppi_deeply_nested():
+    # The reference regex allows at most one path component between /BLPbb/
+    # and the file, losing band/bank for deeper nesting; blit parses the
+    # player component at any depth (blit.naming module docstring).
+    p = naming.parse_guppi_name(
+        "/datax/dibas/S/GUPPI/BLP35/sub/deep/blc35_guppi_1_2_SRC_0001.rawspec.0002.h5"
+    )
+    assert p is not None and (p.band, p.bank) == (3, 5)
+
+
+def test_parse_guppi_rightmost_player_wins():
+    # A BLP-like component in the root path must not shadow the real player
+    # directory (the one closest to the file).
+    p = naming.parse_guppi_name(
+        "/mnt/BLP00/datax/S/GUPPI/BLP42/blc42_guppi_1_2_SRC_0001.rawspec.0002.h5"
+    )
+    assert p is not None and (p.band, p.bank) == (4, 2)
+
+
+def test_parse_guppi_rejects_nonmatching():
+    assert naming.parse_guppi_name("/tmp/notaguppifile.h5") is None
+
+
+def test_parse_rawspec():
+    p = naming.parse_rawspec_name(H5)
+    assert p is not None
+    assert p.product == "0002"
+    assert (p.band, p.bank) == (4, 2)
+
+
+def test_parse_rawspec_requires_suffix():
+    assert naming.parse_rawspec_name(RAW) is None
+    # and requires the /BLPbb/ component:
+    assert (
+        naming.parse_rawspec_name("guppi_59897_21221_X_0011.rawspec.0002.h5") is None
+    )
+
+
+def test_session_re():
+    assert naming.SESSION_RE.search("AGBT22B_999_01")
+    assert naming.SESSION_RE.search("TGBT21A_1_05")
+    assert not naming.SESSION_RE.search("XGBT22B_999_01")
+
+
+def test_player_re_fixed():
+    # The reference's malformed player regex accepted junk like "BLPd3"
+    # (SURVEY.md §2.1); the corrected regex must not.
+    m = naming.PLAYER_RE.match("BLP42")
+    assert m and m.group("band") == "4" and m.group("bank") == "2"
+    assert naming.PLAYER_RE.match("BLPd3") is None
+    assert naming.PLAYER_RE.match("BLP89") is None
+    assert naming.PLAYER_RE.match("BLP421") is None
+
+
+def test_player_name():
+    assert naming.player_name(4, 2) == "BLP42"
+    with pytest.raises(ValueError):
+        naming.player_name(8, 0)

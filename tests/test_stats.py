@@ -1,0 +1,30 @@
+"""Kurtosis golden tests against scipy (StatsBase.kurtosis semantics:
+excess, biased central moments — README.md:216-217)."""
+
+import numpy as np
+import scipy.stats
+
+from blit.ops import kurtosis
+
+
+def test_excess_kurtosis_matches_scipy():
+    rng = np.random.default_rng(42)
+    x = rng.normal(size=1000)
+    got = kurtosis(x[:, None, None])[0, 0]
+    want = scipy.stats.kurtosis(x, fisher=True, bias=True)
+    np.testing.assert_allclose(got, want, rtol=1e-12)
+
+
+def test_kurtosis_shape_and_values_3d():
+    rng = np.random.default_rng(7)
+    data = rng.normal(size=(500, 2, 8))  # (time, pol, chan)
+    got = kurtosis(data, axis=0)
+    assert got.shape == (2, 8)
+    want = scipy.stats.kurtosis(data, axis=0, fisher=True, bias=True)
+    np.testing.assert_allclose(got, want, rtol=1e-10)
+
+
+def test_kurtosis_constant_plus_spike():
+    # A distribution with heavy tails has positive excess kurtosis.
+    x = np.concatenate([np.zeros(999), [100.0]])
+    assert kurtosis(x[:, None, None])[0, 0] > 100
