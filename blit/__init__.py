@@ -27,13 +27,29 @@ __all__ = ["__version__"]
 def __getattr__(name):
     # Lazy submodule access (keeps `import blit` light; JAX-dependent modules
     # only load when touched).
-    if name in ("gbt", "workers", "io", "ops", "parallel", "pipeline"):
+    if name in (
+        "gbt",
+        "workers",
+        "io",
+        "ops",
+        "parallel",
+        "pipeline",
+        "inventory",
+        "naming",
+        "config",
+        "testing",
+    ):
         import importlib
 
         try:
             return importlib.import_module(f"blit.{name}")
-        except ImportError as e:
-            # PEP 562: attribute access must surface AttributeError (e.g. so
-            # hasattr() works), not ModuleNotFoundError.
-            raise AttributeError(f"module 'blit' has no attribute {name!r}") from e
+        except ModuleNotFoundError as e:
+            if e.name == f"blit.{name}":
+                # PEP 562: absent submodule surfaces as AttributeError (so
+                # hasattr() works); genuine dependency failures inside an
+                # existing submodule re-raise unmasked.
+                raise AttributeError(
+                    f"module 'blit' has no attribute {name!r}"
+                ) from e
+            raise
     raise AttributeError(f"module 'blit' has no attribute {name!r}")

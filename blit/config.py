@@ -9,7 +9,7 @@ API function accepts an optional ``config=`` override (SURVEY.md §5 "Config").
 from __future__ import annotations
 
 import re
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import List, Optional, Pattern, Tuple
 
 from blit import naming
@@ -68,6 +68,8 @@ class SiteConfig:
     def with_(self, **kw) -> "SiteConfig":
         from dataclasses import replace
 
+        if "host_prefix" in kw and "hosts" not in kw:
+            kw["hosts"] = None  # re-derive from the new prefix in __post_init__
         return replace(self, **kw)
 
 

@@ -1,0 +1,23 @@
+"""blit.io — host-side file-format codecs.
+
+Replaces the reference's dependency layer (SURVEY.md §2.2): Blio.jl (SIGPROC
+filterbank + GUPPI RAW), HDF5.jl + H5Zbitshuffle.jl (FBH5).  Pure-Python/NumPy
+with optional C++ acceleration from ``blit/native``.
+"""
+
+from blit.io.sigproc import read_fil_header, read_fil_data, write_fil
+from blit.io.fbh5 import is_hdf5, read_fbh5_header, read_fbh5_data, write_fbh5
+from blit.io.guppi import GuppiRaw, read_raw_header, write_raw
+
+__all__ = [
+    "read_fil_header",
+    "read_fil_data",
+    "write_fil",
+    "is_hdf5",
+    "read_fbh5_header",
+    "read_fbh5_data",
+    "write_fbh5",
+    "GuppiRaw",
+    "read_raw_header",
+    "write_raw",
+]

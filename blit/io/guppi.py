@@ -1,0 +1,208 @@
+"""GUPPI RAW voltage-file codec.
+
+Replaces Blio.jl's GUPPI RAW support (SURVEY.md §2.2).  A RAW file is a
+sequence of blocks, each a FITS-like header (80-byte ``KEY = value`` cards,
+terminated by ``END``) followed by ``BLOCSIZE`` bytes of 8-bit complex
+voltages laid out channel-major:
+
+    [OBSNCHAN coarse channels][ntime samples][npol pols][2 int8 (re, im)]
+
+with ``ntime = BLOCSIZE / (OBSNCHAN * npol * 2)``.  ``NPOL=4`` in headers
+means 2 polarizations of complex data (the GUPPI convention).  When
+``DIRECTIO=1`` the header is padded to a 512-byte boundary.  ``OVERLAP`` time
+samples at the end of each block repeat at the start of the next — the PFB
+state-carry the reference never handled (its RAW path stops at inventory;
+SURVEY.md §7 "hard parts").
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+CARD_LEN = 80
+DIRECTIO_ALIGN = 512
+
+
+def _parse_card_value(raw: str):
+    s = raw.strip()
+    if s.startswith("'"):
+        return s.strip("'").rstrip()
+    try:
+        return int(s)
+    except ValueError:
+        pass
+    try:
+        return float(s)
+    except ValueError:
+        pass
+    return s
+
+
+def _format_card(key: str, value) -> bytes:
+    if isinstance(value, str):
+        vs = f"'{value:<8s}'"
+    elif isinstance(value, bool):
+        vs = "T" if value else "F"
+    elif isinstance(value, float):
+        vs = f"{value:.12G}"
+    else:
+        vs = str(value)
+    card = f"{key:<8s}= {vs}"
+    if len(card) > CARD_LEN:
+        raise ValueError(f"guppi card too long: {card!r}")
+    return card.ljust(CARD_LEN).encode("ascii")
+
+
+def read_raw_header(f) -> Tuple[Dict, int]:
+    """Read one block header from the current file position.
+
+    Returns ``(header, data_offset)`` where ``data_offset`` accounts for
+    DIRECTIO padding.  Raises ``EOFError`` at end of file.
+    """
+    hdr: Dict = {}
+    start = f.tell()
+    while True:
+        card = f.read(CARD_LEN)
+        if len(card) < CARD_LEN:
+            if not hdr and len(card) == 0:
+                raise EOFError
+            raise ValueError("guppi: truncated header card")
+        text = card.decode("ascii", errors="replace")
+        key = text[:8].strip()
+        if key == "END":
+            break
+        if "=" not in text:
+            raise ValueError(f"guppi: malformed card {text!r}")
+        hdr[key] = _parse_card_value(text.split("=", 1)[1])
+    end = f.tell()
+    if hdr.get("DIRECTIO", 0):
+        pad = (-(end - start)) % DIRECTIO_ALIGN
+        f.seek(pad, os.SEEK_CUR)
+    return hdr, f.tell()
+
+
+def block_ntime(hdr: Dict) -> int:
+    """Time samples per block implied by the header."""
+    npol = 2 if hdr["NPOL"] > 2 else hdr["NPOL"]
+    nbits = hdr.get("NBITS", 8)
+    bytes_per_samp = hdr["OBSNCHAN"] * npol * 2 * nbits // 8
+    return hdr["BLOCSIZE"] // bytes_per_samp
+
+
+class GuppiRaw:
+    """One GUPPI RAW file: indexed access to (header, voltage-block) pairs.
+
+    Scans block boundaries once at construction (headers only — cheap), then
+    reads blocks on demand via memmap slices so large files never fully load.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self.headers: List[Dict] = []
+        self._data_offsets: List[int] = []
+        with open(path, "rb") as f:
+            size = os.path.getsize(path)
+            while True:
+                try:
+                    hdr, off = read_raw_header(f)
+                except EOFError:
+                    break
+                if off + hdr["BLOCSIZE"] > size:
+                    break  # truncated trailing block
+                self.headers.append(hdr)
+                self._data_offsets.append(off)
+                f.seek(hdr["BLOCSIZE"], os.SEEK_CUR)
+
+    @property
+    def nblocks(self) -> int:
+        return len(self.headers)
+
+    def header(self, i: int = 0) -> Dict:
+        return self.headers[i]
+
+    def read_block(self, i: int) -> np.ndarray:
+        """Raw int8 voltages of block ``i``, shaped
+        ``(obsnchan, ntime, npol, 2)`` (last axis = re, im)."""
+        hdr = self.headers[i]
+        nbits = hdr.get("NBITS", 8)
+        if nbits != 8:
+            raise NotImplementedError(f"NBITS={nbits} not supported (GBT uses 8)")
+        npol = 2 if hdr["NPOL"] > 2 else hdr["NPOL"]
+        ntime = block_ntime(hdr)
+        mm = np.memmap(
+            self.path,
+            dtype=np.int8,
+            mode="r",
+            offset=self._data_offsets[i],
+            shape=(hdr["OBSNCHAN"], ntime, npol, 2),
+        )
+        return mm
+
+    def read_block_complex(self, i: int) -> np.ndarray:
+        """Block ``i`` as complex64, shaped ``(obsnchan, ntime, npol)``."""
+        b = self.read_block(i).astype(np.float32)
+        return (b[..., 0] + 1j * b[..., 1]).astype(np.complex64)
+
+    def iter_blocks(
+        self, drop_overlap: bool = False
+    ) -> Iterator[Tuple[Dict, np.ndarray]]:
+        """Yield ``(header, block)`` pairs; ``drop_overlap=True`` trims the
+        trailing ``OVERLAP`` samples of every block except the last, giving a
+        gap-free concatenation along time."""
+        for i in range(self.nblocks):
+            hdr = self.headers[i]
+            block = self.read_block(i)
+            if drop_overlap and i < self.nblocks - 1:
+                ov = hdr.get("OVERLAP", 0)
+                if ov:
+                    block = block[:, :-ov]
+            yield hdr, block
+
+    def time_span_s(self) -> float:
+        """Total (overlap-corrected) duration covered by the file."""
+        if not self.headers:
+            return 0.0
+        tbin = self.headers[0].get("TBIN", 0.0)
+        total = 0
+        for i, hdr in enumerate(self.headers):
+            nt = block_ntime(hdr)
+            if i < self.nblocks - 1:
+                nt -= hdr.get("OVERLAP", 0)
+            total += nt
+        return total * tbin
+
+
+def write_raw(
+    path: str,
+    header: Dict,
+    blocks: List[np.ndarray],
+    directio: bool = False,
+) -> None:
+    """Write a GUPPI RAW file (fixture generator and pipeline output).
+
+    ``blocks``: int8 arrays shaped ``(obsnchan, ntime, npol, 2)``.  Per-block
+    headers are derived from ``header`` with ``BLOCSIZE``/``PKTIDX`` updated.
+    """
+    hdr = dict(header)
+    hdr["DIRECTIO"] = 1 if directio else 0
+    pktidx = int(hdr.get("PKTIDX", 0))
+    with open(path, "wb") as f:
+        for blk in blocks:
+            if blk.dtype != np.int8 or blk.ndim != 4 or blk.shape[3] != 2:
+                raise ValueError("write_raw: blocks must be int8 (nchan, ntime, npol, 2)")
+            nchan, ntime, npol, _ = blk.shape
+            hdr["OBSNCHAN"] = nchan
+            hdr["NPOL"] = 4 if npol == 2 else npol
+            hdr["NBITS"] = 8
+            hdr["BLOCSIZE"] = blk.nbytes
+            hdr["PKTIDX"] = pktidx
+            pktidx += ntime - int(hdr.get("OVERLAP", 0))
+            cards = b"".join(_format_card(k, v) for k, v in hdr.items())
+            cards += "END".ljust(CARD_LEN).encode("ascii")
+            f.write(cards)
+            if directio:
+                f.write(b"\x00" * ((-len(cards)) % DIRECTIO_ALIGN))
+            f.write(np.ascontiguousarray(blk).tobytes())

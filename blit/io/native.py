@@ -1,0 +1,65 @@
+"""Loader for blit's native (C++) acceleration libraries.
+
+SURVEY.md §2.3: the reference's native surface lives in its dependencies —
+the bitshuffle HDF5 filter (C/SSE2/AVX2) and Blio's block readers.  blit
+provides C++ equivalents under ``blit/native/``; this module locates the
+built artifacts and degrades gracefully (NumPy fallbacks) when absent.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "native", "build")
+_plugin_registered = False
+
+
+def native_dir() -> str:
+    return os.path.abspath(_NATIVE_DIR)
+
+
+def lib_path(name: str) -> Optional[str]:
+    p = os.path.join(native_dir(), name)
+    return p if os.path.exists(p) else None
+
+
+def ensure_hdf5_plugin_path() -> bool:
+    """Make libhdf5 see blit's filter plugins (bitshuffle+LZ4).
+
+    Must run before the first h5py File open that needs the filter.  Uses the
+    HDF5 plugin-path API via h5py so it works even after HDF5_PLUGIN_PATH has
+    been read at library init.
+    """
+    global _plugin_registered
+    if _plugin_registered:
+        return True
+    d = native_dir()
+    if not os.path.isdir(d) or not any(
+        f.startswith("libblit_h5bshuf") for f in os.listdir(d)
+    ):
+        return False
+    try:
+        import h5py
+
+        h5py.h5pl.prepend(d.encode())
+        _plugin_registered = True
+        return True
+    except Exception:
+        return False
+
+
+_guppi_lib = None
+
+
+def guppi_lib() -> Optional[ctypes.CDLL]:
+    """ctypes handle to the C++ GUPPI block reader, or None if not built."""
+    global _guppi_lib
+    if _guppi_lib is not None:
+        return _guppi_lib
+    p = lib_path("libblit_guppi.so")
+    if p is None:
+        return None
+    _guppi_lib = ctypes.CDLL(p)
+    return _guppi_lib

@@ -1,0 +1,154 @@
+"""SIGPROC filterbank (.fil) codec.
+
+Replaces Blio.jl's ``Filterbank.Header`` / ``Filterbank.mmap``
+(reference usage: src/gbtworkerfunctions.jl:131-139, 171-177).
+
+Format: a binary header of length-prefixed keyword items bracketed by
+``HEADER_START``/``HEADER_END``, followed by raw samples.  Sample layout is
+time-major — for each time sample, ``nifs`` spectra of ``nchans`` values —
+i.e. C-order ``(nsamps, nifs, nchans)``, memory-identical to the reference's
+column-major ``(nchans, nifs, nsamps)`` (see blit/ops/fqav.py layout note).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import BinaryIO, Dict, Optional, Tuple
+
+import numpy as np
+
+# Keyword -> value type.  The SIGPROC header is self-describing only in
+# keyword names, so the codec needs this table (same set Blio.jl understands).
+_STRING_KEYS = {"source_name", "rawdatafile"}
+_INT_KEYS = {
+    "telescope_id",
+    "machine_id",
+    "data_type",
+    "barycentric",
+    "pulsarcentric",
+    "nbits",
+    "nsamples",
+    "nchans",
+    "nifs",
+    "nbeams",
+    "ibeam",
+    "nbins",
+}
+_DOUBLE_KEYS = {
+    "az_start",
+    "za_start",
+    "src_raj",
+    "src_dej",
+    "tstart",
+    "tsamp",
+    "fch1",
+    "foff",
+    "refdm",
+    "period",
+}
+
+_DTYPES = {8: np.uint8, 16: np.uint16, 32: np.float32}
+
+
+def _read_string(f: BinaryIO) -> str:
+    (n,) = struct.unpack("<i", f.read(4))
+    if not 0 < n < 256:
+        raise ValueError(f"sigproc: implausible header string length {n}")
+    return f.read(n).decode("ascii")
+
+
+def _write_string(f: BinaryIO, s: str) -> None:
+    b = s.encode("ascii")
+    f.write(struct.pack("<i", len(b)))
+    f.write(b)
+
+
+def read_fil_header(path: str) -> Tuple[Dict, int]:
+    """Read a SIGPROC header.  Returns ``(header_dict, data_offset_bytes)``.
+
+    The dict holds the raw on-disk keywords plus computed ``nsamps`` (from
+    file size when ``nsamples`` is absent/zero, as Blio does).
+    """
+    hdr: Dict = {}
+    with open(path, "rb") as f:
+        magic = _read_string(f)
+        if magic != "HEADER_START":
+            raise ValueError(f"{path}: not a SIGPROC filterbank file")
+        while True:
+            key = _read_string(f)
+            if key == "HEADER_END":
+                break
+            if key in _STRING_KEYS:
+                hdr[key] = _read_string(f)
+            elif key in _INT_KEYS:
+                (hdr[key],) = struct.unpack("<i", f.read(4))
+            elif key in _DOUBLE_KEYS:
+                (hdr[key],) = struct.unpack("<d", f.read(8))
+            else:
+                raise ValueError(f"{path}: unknown sigproc header keyword {key!r}")
+        offset = f.tell()
+    nbits = hdr.get("nbits", 32)
+    nchans = hdr.get("nchans", 1)
+    nifs = hdr.get("nifs", 1)
+    sample_bytes = nchans * nifs * nbits // 8
+    data_bytes = os.path.getsize(path) - offset
+    hdr["nsamps"] = data_bytes // sample_bytes if sample_bytes else 0
+    return hdr, offset
+
+
+def read_fil_data(
+    path: str, header: Optional[Dict] = None, mmap: bool = True
+) -> Tuple[Dict, np.ndarray]:
+    """Return ``(header, data)`` with data shaped ``(nsamps, nifs, nchans)``.
+
+    ``mmap=True`` returns a read-only memmap (the analog of
+    ``Filterbank.mmap``, src/gbtworkerfunctions.jl:173); callers slice it and
+    the memmap is unmapped when garbage-collected.
+    """
+    if header is None:
+        header, offset = read_fil_header(path)
+    else:
+        _, offset = read_fil_header(path)
+    nbits = header.get("nbits", 32)
+    if nbits not in _DTYPES:
+        raise ValueError(f"{path}: unsupported nbits={nbits}")
+    shape = (header["nsamps"], header.get("nifs", 1), header["nchans"])
+    if mmap:
+        data = np.memmap(path, dtype=_DTYPES[nbits], mode="r", offset=offset, shape=shape)
+    else:
+        with open(path, "rb") as f:
+            f.seek(offset)
+            data = np.fromfile(f, dtype=_DTYPES[nbits]).reshape(shape)
+    return header, data
+
+
+def write_fil(path: str, header: Dict, data: np.ndarray) -> None:
+    """Write a SIGPROC filterbank file.
+
+    ``data`` must be shaped ``(nsamps, nifs, nchans)``; dtype determines
+    ``nbits``.  Header keywords not in the SIGPROC keyword tables are ignored
+    (so normalized headers round-trip).
+    """
+    if data.ndim != 3:
+        raise ValueError("write_fil: data must be (nsamps, nifs, nchans)")
+    nbits = {np.uint8: 8, np.uint16: 16, np.float32: 32}[data.dtype.type]
+    hdr = dict(header)
+    hdr["nbits"] = nbits
+    hdr["nchans"] = data.shape[2]
+    hdr["nifs"] = data.shape[1]
+    with open(path, "wb") as f:
+        _write_string(f, "HEADER_START")
+        for key, val in hdr.items():
+            if key in _STRING_KEYS:
+                _write_string(f, key)
+                _write_string(f, str(val))
+            elif key in _INT_KEYS:
+                _write_string(f, key)
+                f.write(struct.pack("<i", int(val)))
+            elif key in _DOUBLE_KEYS:
+                _write_string(f, key)
+                f.write(struct.pack("<d", float(val)))
+            # silently skip computed/unknown keys (nsamps, nfpc, data_size, ...)
+        _write_string(f, "HEADER_END")
+        np.ascontiguousarray(data).tofile(f)

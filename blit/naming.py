@@ -36,7 +36,7 @@ DEFAULT_FILE_RE = re.compile(r"0002\.h5$")
 # between /BLPbb/ and the file (src/gbtworkerfunctions.jl:38), silently losing
 # band/bank for deeper nesting; parsing the path component-wise removes that
 # limitation while keeping band/bank semantics identical.
-PLAYER_COMPONENT_RE = re.compile(r"/BLP(?P<band>[0-7])(?P<bank>[0-7])/")
+PLAYER_COMPONENT_RE = re.compile(r"/BLP(?P<band>[0-7])(?P<bank>[0-7])(?=/)")
 
 # GUPPI-convention file basename, e.g.
 #   blc42_guppi_59897_21221_HD_84406_0011.rawspec.0002.h5

@@ -1,0 +1,34 @@
+"""DC-spike repair.
+
+Each coarse channel's center fine channel carries the FFT DC artifact; the
+repair copies the neighboring fine channel over it.  Reference (the only
+in-repo evidence, in the commented-out ``loadscan``): spike index
+``nfpc÷2 + 1`` (1-based), repaired as
+``d[spike:nfpc:end,:,:] .= d[spike-1:nfpc:end,:,:]`` (src/gbt.jl:101-111).
+In blit's 0-based ``(time, pol, chan)`` layout the spike sits at ``nfpc//2``
+within each coarse channel on the last axis.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def despike(data, nfpc: int):
+    """Return ``data`` with every coarse channel's DC fine channel replaced
+    by its lower neighbor, along the last (channel) axis.
+
+    Works on NumPy (copies) and JAX arrays (functional ``.at`` update).
+    ``nfpc`` must divide the channel count and be >= 2.
+    """
+    nchan = data.shape[-1]
+    if nfpc < 2 or nchan % nfpc:
+        raise ValueError(f"despike: nfpc={nfpc} invalid for {nchan} channels")
+    spike = nfpc // 2
+    src = slice(spike - 1, None, nfpc)
+    dst = slice(spike, None, nfpc)
+    if isinstance(data, np.ndarray):
+        out = data.copy()
+        out[..., dst] = data[..., src]
+        return out
+    return data.at[..., dst].set(data[..., src])

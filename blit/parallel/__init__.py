@@ -1,0 +1,17 @@
+"""blit.parallel — worker pools (host fan-out) and the TPU device mesh
+(collective data plane).
+
+The reference's single parallelism is embarrassingly-parallel fan-out over
+ssh workers (SURVEY.md §2.4).  blit splits that into:
+
+- ``pool``: the control plane — a host-side worker pool with pluggable
+  backends (local / thread / process), per-call error capture, and ragged
+  per-worker results.
+- ``mesh`` / ``stitch`` / ``beamform`` / ``correlator``: the data plane —
+  the (band, bank) ``jax.sharding.Mesh`` where cross-worker reductions run
+  as XLA collectives over ICI instead of main-process concatenation.
+"""
+
+from blit.parallel.pool import WorkerError, WorkerPool, setup_workers, current_pool
+
+__all__ = ["WorkerError", "WorkerPool", "setup_workers", "current_pool"]

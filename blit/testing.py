@@ -1,0 +1,191 @@
+"""Synthetic BL@GBT data generators (test fixtures + benchmark inputs).
+
+The reference ships no fixtures at all (SURVEY.md §4); these generators are
+the foundation of blit's far larger test surface: round-trip tests for every
+codec, fake observation trees for the inventory crawl, and deterministic
+voltage streams with injected tones for end-to-end pipeline validation.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from blit.config import COARSE_MHZ, nfpc_from_foff
+from blit.io import write_fbh5, write_fil, write_raw
+
+
+def make_fil_header(
+    nchans: int = 64,
+    nifs: int = 1,
+    fch1: float = 8437.5,
+    foff: Optional[float] = None,
+    tsamp: float = 1.0e-3,
+    tstart: float = 59897.0,
+    source_name: str = "SYNTH",
+) -> Dict:
+    """A plausible GBT filterbank header; ``foff`` defaults to one coarse
+    channel per fine channel bank slice (nfpc computes cleanly)."""
+    if foff is None:
+        foff = -COARSE_MHZ / max(nchans // 64, 1)
+    return {
+        "telescope_id": 6,  # GBT
+        "machine_id": 0,
+        "data_type": 1,
+        "source_name": source_name,
+        "barycentric": 0,
+        "pulsarcentric": 0,
+        "az_start": 0.0,
+        "za_start": 0.0,
+        "src_raj": 120000.0,
+        "src_dej": 450000.0,
+        "tstart": tstart,
+        "tsamp": tsamp,
+        "fch1": fch1,
+        "foff": foff,
+        "nchans": nchans,
+        "nifs": nifs,
+    }
+
+
+def make_spectra(
+    nsamps: int = 16,
+    nifs: int = 1,
+    nchans: int = 64,
+    seed: int = 0,
+    dtype=np.float32,
+) -> np.ndarray:
+    """Deterministic positive 'power' spectra shaped (nsamps, nifs, nchans)."""
+    rng = np.random.default_rng(seed)
+    base = rng.chisquare(4, size=(nsamps, nifs, nchans))
+    ramp = 1.0 + np.arange(nchans) / nchans
+    return (base * ramp).astype(dtype)
+
+
+def synth_fil(path: str, nsamps=16, nifs=1, nchans=64, seed=0, **hdrkw) -> Tuple[Dict, np.ndarray]:
+    hdr = make_fil_header(nchans=nchans, nifs=nifs, **hdrkw)
+    data = make_spectra(nsamps, nifs, nchans, seed)
+    write_fil(path, hdr, data)
+    return hdr, data
+
+
+def synth_fbh5(
+    path: str, nsamps=16, nifs=1, nchans=64, seed=0, compression=None, **hdrkw
+) -> Tuple[Dict, np.ndarray]:
+    hdr = make_fil_header(nchans=nchans, nifs=nifs, **hdrkw)
+    hdr["nfpc"] = nfpc_from_foff(hdr["foff"])
+    data = make_spectra(nsamps, nifs, nchans, seed)
+    write_fbh5(path, hdr, data, compression=compression)
+    return hdr, data
+
+
+def make_raw_header(
+    obsnchan: int = 64,
+    npol: int = 2,
+    obsfreq: float = 8437.5,
+    obsbw: float = 187.5,
+    tbin: Optional[float] = None,
+    overlap: int = 0,
+    src_name: str = "SYNTH",
+    stt_imjd: int = 59897,
+    stt_smjd: int = 21221,
+) -> Dict:
+    if tbin is None:
+        tbin = abs(obsnchan / (obsbw * 1e6))  # critically sampled
+    return {
+        "SRC_NAME": src_name,
+        "TELESCOP": "GBT",
+        "OBSFREQ": obsfreq,
+        "OBSBW": obsbw,
+        "OBSNCHAN": obsnchan,
+        "NPOL": 4 if npol == 2 else npol,
+        "NBITS": 8,
+        "TBIN": tbin,
+        "OVERLAP": overlap,
+        "STT_IMJD": stt_imjd,
+        "STT_SMJD": stt_smjd,
+        "PKTIDX": 0,
+        "CHAN_BW": obsbw / obsnchan,
+    }
+
+
+def make_voltages(
+    obsnchan: int,
+    ntime: int,
+    npol: int = 2,
+    seed: int = 0,
+    tone_chan: Optional[int] = None,
+    tone_freq: float = 0.25,
+    tone_amp: float = 20.0,
+    noise_rms: float = 8.0,
+) -> np.ndarray:
+    """Quantized complex voltages (obsnchan, ntime, npol, 2) int8: Gaussian
+    noise plus an optional complex tone in one coarse channel (a drift-free
+    'technosignature' for end-to-end detection tests)."""
+    rng = np.random.default_rng(seed)
+    v = rng.normal(0.0, noise_rms, size=(obsnchan, ntime, npol, 2))
+    if tone_chan is not None:
+        ph = 2 * np.pi * tone_freq * np.arange(ntime)
+        v[tone_chan, :, :, 0] += tone_amp * np.cos(ph)[:, None]
+        v[tone_chan, :, :, 1] += tone_amp * np.sin(ph)[:, None]
+    return np.clip(np.round(v), -128, 127).astype(np.int8)
+
+
+def synth_raw(
+    path: str,
+    nblocks: int = 2,
+    obsnchan: int = 64,
+    ntime_per_block: int = 1024,
+    npol: int = 2,
+    overlap: int = 0,
+    directio: bool = False,
+    seed: int = 0,
+    tone_chan: Optional[int] = None,
+    **hdrkw,
+) -> Tuple[Dict, List[np.ndarray]]:
+    """Write a synthetic GUPPI RAW file.  With ``overlap`` > 0, consecutive
+    blocks share their trailing/leading ``overlap`` samples, as on disk at
+    GBT."""
+    hdr = make_raw_header(obsnchan=obsnchan, npol=npol, overlap=overlap, **hdrkw)
+    step = ntime_per_block - overlap
+    total = step * (nblocks - 1) + ntime_per_block
+    stream = make_voltages(obsnchan, total, npol, seed=seed, tone_chan=tone_chan)
+    blocks = [stream[:, i * step : i * step + ntime_per_block] for i in range(nblocks)]
+    write_raw(path, hdr, blocks, directio=directio)
+    return hdr, blocks
+
+
+def build_observation_tree(
+    root: str,
+    session: str = "AGBT22B_999_01",
+    scans: Tuple[str, ...] = ("0011",),
+    players: Tuple[Tuple[int, int], ...] = ((0, 0), (0, 1)),
+    nsamps: int = 16,
+    nchans: int = 64,
+    kind: str = "fbh5",
+) -> List[str]:
+    """A fake BL@GBT data tree: ``<root>/<session>/GUPPI/BLPbb/<guppi name>``
+    with real, readable product files.  Returns created paths."""
+    paths = []
+    for band, bank in players:
+        player = f"BLP{band}{bank}"
+        host = f"blc{band}{bank}"
+        d = os.path.join(root, session, "GUPPI", player)
+        os.makedirs(d, exist_ok=True)
+        for scan in scans:
+            base = f"{host}_guppi_59897_21221_HD_84406_{scan}"
+            if kind == "fbh5":
+                p = os.path.join(d, base + ".rawspec.0002.h5")
+                synth_fbh5(p, nsamps=nsamps, nchans=nchans, seed=band * 8 + bank)
+            elif kind == "fil":
+                p = os.path.join(d, base + ".rawspec.0002.fil")
+                synth_fil(p, nsamps=nsamps, nchans=nchans, seed=band * 8 + bank)
+            elif kind == "raw":
+                p = os.path.join(d, base + ".0000.raw")
+                synth_raw(p, obsnchan=nchans)
+            else:
+                raise ValueError(f"unknown kind {kind!r}")
+            paths.append(p)
+    return paths

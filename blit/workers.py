@@ -1,0 +1,118 @@
+"""Per-worker data-access functions.
+
+The rebuild of ``GBT.WorkerFunctions`` (src/gbtworkerfunctions.jl) — every
+function here runs *on the host that owns the files* (or in-process for the
+local backend) and returns reduced results, keeping the reference's key
+design lever: reduce worker-side, before the wire (SURVEY.md §3.3).
+
+Index convention: blit arrays are C-order ``(time, pol, chan)`` (see
+blit/ops/fqav.py); ``idxs`` is a 3-tuple over those axes, 0-based, ints
+sanitized to length-1 slices so results are always 3-D (reference:
+``sanitizeidxs``, src/gbtworkerfunctions.jl:167-169).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from blit.config import nfpc_from_foff
+from blit.inventory import get_inventory  # noqa: F401  (re-export: workers run it)
+from blit.io import fbh5, sigproc
+from blit.ops.fqav import fqav, fqav_range
+from blit.ops.stats import kurtosis as _kurtosis
+
+Idxs = Tuple
+
+
+def sanitize_idxs(idxs: Idxs) -> Idxs:
+    """Replace integer indices with length-1 slices so indexing never drops
+    a dimension (reference: src/gbtworkerfunctions.jl:167-169)."""
+    return tuple(
+        slice(i, i + 1) if isinstance(i, (int, np.integer)) else i for i in idxs
+    )
+
+
+def get_fb_header(path: str) -> Dict:
+    """Normalized SIGPROC header: on-disk keywords + computed ``nfpc`` (the
+    GBT constant 187.5/64 over |foff|), ``nsamps`` and ``data_size``; no
+    ``header_size``/``sample_size`` — FBH5 parity (reference:
+    src/gbtworkerfunctions.jl:131-139)."""
+    hdr, _ = sigproc.read_fil_header(path)
+    hdr["nfpc"] = nfpc_from_foff(hdr["foff"])
+    hdr["data_size"] = (
+        hdr["nsamps"] * hdr.get("nifs", 1) * hdr["nchans"] * hdr.get("nbits", 32) // 8
+    )
+    return dict(sorted(hdr.items()))
+
+
+def get_fbh5_header(path: str) -> Dict:
+    """Normalized FBH5 header (reference: src/gbtworkerfunctions.jl:141-155,
+    with the missing-nfpc crash fixed)."""
+    return fbh5.read_fbh5_header(path)
+
+
+def get_header(path: str) -> Dict:
+    """Format dispatch (reference: src/gbtworkerfunctions.jl:157-159)."""
+    return get_fbh5_header(path) if fbh5.is_hdf5(path) else get_fb_header(path)
+
+
+_ALL = (slice(None), slice(None), slice(None))
+
+
+def get_fb_data(
+    path: str,
+    idxs: Idxs = _ALL,
+    fqav_by: int = 1,
+    fqav_func: Optional[Callable] = None,
+) -> np.ndarray:
+    """Memmap a .fil file, materialize the requested slab, frequency-average
+    (reference: src/gbtworkerfunctions.jl:171-177; the explicit finalize is
+    unnecessary here — the memmap unmaps on GC)."""
+    if len(idxs) != 3:
+        raise ValueError("idxs must have exactly three indices")
+    _, mm = sigproc.read_fil_data(path, mmap=True)
+    data = np.ascontiguousarray(mm[idxs])
+    del mm
+    return fqav(data, fqav_by, f=fqav_func)
+
+
+def get_fbh5_data(
+    path: str,
+    idxs: Idxs = _ALL,
+    fqav_by: int = 1,
+    fqav_func: Optional[Callable] = None,
+) -> np.ndarray:
+    """Hyperslab-read an FBH5 file then frequency-average — averaging is
+    post-read, on the worker (reference: src/gbtworkerfunctions.jl:179-189)."""
+    data = fbh5.read_fbh5_data(path, idxs)
+    return fqav(data, fqav_by, f=fqav_func)
+
+
+def get_data(
+    path: str,
+    idxs: Idxs = _ALL,
+    fqav_by: int = 1,
+    fqav_func: Optional[Callable] = None,
+) -> np.ndarray:
+    """Sanitize indices, dispatch on format (reference:
+    src/gbtworkerfunctions.jl:191-195)."""
+    idxs = sanitize_idxs(idxs)
+    reader = get_fbh5_data if fbh5.is_hdf5(path) else get_fb_data
+    return reader(path, idxs, fqav_by=fqav_by, fqav_func=fqav_func)
+
+
+def get_kurtosis(path: str, idxs: Idxs = _ALL) -> np.ndarray:
+    """Excess kurtosis over time per (chan, pol), full time resolution
+    (reference: src/gbtworkerfunctions.jl:197-202).  Returns shape
+    ``(nchan, nifs)`` to preserve the reference's ``[chan, if]`` indexing."""
+    data = get_data(path, idxs)
+    return np.asarray(_kurtosis(data, axis=0)).T
+
+
+def get_freq_axis(header: Dict, fqav_by: int = 1) -> Tuple[float, float, int]:
+    """The (fch1, foff, nchans) triple of a file's channel axis after
+    optional frequency averaging — the range arithmetic the reference
+    exposes as ``fqav(::AbstractRange, n)`` (src/gbtworkerfunctions.jl:27-33)."""
+    return fqav_range(header["fch1"], header["foff"], header["nchans"], fqav_by)

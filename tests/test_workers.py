@@ -1,0 +1,111 @@
+"""Worker-function semantics parity (src/gbtworkerfunctions.jl:131-202)."""
+
+import numpy as np
+import pytest
+
+from blit import testing, workers
+from blit.config import nfpc_from_foff
+from blit.ops.despike import despike
+
+
+@pytest.fixture()
+def fil_file(tmp_path):
+    p = str(tmp_path / "x.fil")
+    hdr, data = testing.synth_fil(p, nsamps=32, nifs=2, nchans=64)
+    return p, hdr, data
+
+
+@pytest.fixture()
+def fbh5_file(tmp_path):
+    p = str(tmp_path / "x.h5")
+    hdr, data = testing.synth_fbh5(p, nsamps=32, nifs=2, nchans=64)
+    return p, hdr, data
+
+
+def test_sanitize_idxs():
+    out = workers.sanitize_idxs((3, slice(None), slice(1, 5)))
+    assert out == (slice(3, 4), slice(None), slice(1, 5))
+
+
+def test_get_fb_header_normalized(fil_file):
+    p, hdr, data = fil_file
+    h = workers.get_fb_header(p)
+    assert h["nfpc"] == nfpc_from_foff(hdr["foff"])
+    assert "header_size" not in h and "sample_size" not in h
+    assert h["data_size"] == data.nbytes
+    assert h["nsamps"] == 32
+    assert list(h) == sorted(h)
+
+
+def test_get_header_dispatch(fil_file, fbh5_file):
+    pf, _, _ = fil_file
+    ph, _, _ = fbh5_file
+    assert workers.get_header(pf)["nchans"] == 64
+    assert workers.get_header(ph)["nchans"] == 64
+
+
+def test_get_data_always_3d(fbh5_file):
+    p, _, data = fbh5_file
+    out = workers.get_data(p, (5, 0, slice(None)))
+    assert out.shape == (1, 1, 64)  # ints became length-1 slices
+    np.testing.assert_array_equal(out[0, 0], data[5, 0])
+
+
+def test_get_data_fqav_fil_vs_fbh5(fil_file, fbh5_file):
+    pf, _, df = fil_file
+    ph, _, dh = fbh5_file
+    of = workers.get_data(pf, fqav_by=8)
+    oh = workers.get_data(ph, fqav_by=8)
+    assert of.shape == oh.shape == (32, 2, 8)
+    np.testing.assert_allclose(of, df.reshape(32, 2, 8, 8).sum(-1), rtol=1e-6)
+    np.testing.assert_allclose(of, oh, rtol=1e-6)
+
+
+def test_get_data_fqav_func_mean(fbh5_file):
+    p, _, data = fbh5_file
+    out = workers.get_data(p, fqav_by=4, fqav_func=np.mean)
+    np.testing.assert_allclose(out, data.reshape(32, 2, 16, 4).mean(-1), rtol=1e-6)
+
+
+def test_get_kurtosis_shape_and_transpose(fbh5_file):
+    p, _, data = fbh5_file
+    k = workers.get_kurtosis(p)
+    assert k.shape == (64, 2)  # (nchan, nifs) — reference indexing parity
+    import scipy.stats
+
+    want = scipy.stats.kurtosis(data, axis=0, fisher=True, bias=True).T
+    np.testing.assert_allclose(k, want, rtol=1e-5)
+
+
+def test_get_freq_axis(fbh5_file):
+    p, hdr, _ = fbh5_file
+    h = workers.get_header(p)
+    fch1, foff, n = workers.get_freq_axis(h, fqav_by=8)
+    assert n == 8
+    assert foff == pytest.approx(8 * hdr["foff"])
+
+
+def test_despike():
+    nfpc = 8
+    data = np.ones((2, 1, 32), dtype=np.float32)
+    spike = nfpc // 2
+    data[:, :, spike::nfpc] = 99.0
+    out = despike(data, nfpc)
+    assert (out == 1.0).all()
+    assert (data[:, :, spike::nfpc] == 99.0).all()  # input untouched
+
+
+def test_despike_jax():
+    import jax.numpy as jnp
+
+    nfpc = 4
+    data = jnp.arange(16.0).reshape(1, 1, 16)
+    out = despike(data, nfpc)
+    np.testing.assert_array_equal(
+        np.asarray(out[0, 0]), [0, 1, 1, 3, 4, 5, 5, 7, 8, 9, 9, 11, 12, 13, 13, 15]
+    )
+
+
+def test_despike_invalid():
+    with pytest.raises(ValueError):
+        despike(np.zeros((1, 1, 10)), 4)
