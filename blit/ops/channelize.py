@@ -1,0 +1,351 @@
+"""GUPPI RAW → high-resolution filterbank reduction: the TPU compute core.
+
+This is the per-``BLP<band><bank>`` worker reduction the reference delegates
+to ``rawspec`` on CUDA nodes (SURVEY.md §0: products ``*_<scan>.rawspec.NNNN``;
+BASELINE.json config 2).  The rebuild is pure JAX — everything here is
+jittable with static shapes, so XLA fuses dequantization, the polyphase
+frontend, Stokes detection and integration around the FFT:
+
+    int8 voltages (nchan_coarse, ntime, npol, 2)
+      → dequant (float32 complex)
+      → 4-tap polyphase filter bank frontend (windowed-sinc FIR)
+      → nfft-point FFT per coarse channel  (four-step for the 1M-pt case)
+      → fftshift (DC lands at fine index nfft//2 — exactly where the
+        reference's despike expects it, src/gbt.jl:101-111)
+      → Stokes detect (I / XXYY / full-pol / IQUV)
+      → time integrate by ``nint``
+      → (ntime_out, nif, nchan_coarse*nfft) float32 filterbank slab
+
+TPU notes (pallas_guide.md; SURVEY.md §7 "hard parts"):
+
+- The 1M-point FFT exceeds VMEM as a monolith.  ``fft`` therefore factors
+  N = N1·N2 and runs two batched small FFTs plus a twiddle multiply (the
+  classic four-step decomposition) — each stage is a contiguous batch of
+  ≤8K-point FFTs that XLA tiles comfortably; the twiddle and transpose fuse.
+- All control flow is static; ``jax.lax`` only.  No data-dependent shapes.
+- The FIR stage runs on separate real/imag float32 planes (``dequantize``),
+  keeping it real-valued VPU/MXU work; the FFT recombines via
+  ``lax.complex``.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+STOKES_NIF = {"I": 1, "XX": 1, "YY": 1, "XXYY": 2, "full": 4, "IQUV": 4}
+
+# Largest FFT run as a single jnp.fft call; above this, four-step decompose.
+_DIRECT_FFT_MAX = 8192
+
+
+def pfb_coeffs(ntap: int, nfft: int, window: str = "hamming") -> np.ndarray:
+    """Windowed-sinc prototype filter for the polyphase frontend, shaped
+    ``(ntap, nfft)`` and normalized to unit DC gain per fine channel.
+
+    Matches the standard rawspec/CASPER design: ``sinc(x)·w(n)`` over
+    ``ntap*nfft`` taps with the sinc main lobe spanning one fine channel.
+    """
+    n = np.arange(ntap * nfft, dtype=np.float64)
+    x = n / nfft - ntap / 2.0
+    sinc = np.sinc(x)
+    if window == "hamming":
+        win = np.hamming(ntap * nfft)
+    elif window == "hanning":
+        win = np.hanning(ntap * nfft)
+    elif window == "rect":
+        win = np.ones(ntap * nfft)
+    else:
+        raise ValueError(f"unknown window {window!r}")
+    h = sinc * win
+    h /= h.sum()  # unit DC gain: a constant input yields 1.0 in the DC bin pre-FFT-scaling
+    return h.reshape(ntap, nfft).astype(np.float32)
+
+
+def dequantize(voltages: jax.Array, dtype=jnp.float32) -> Tuple[jax.Array, jax.Array]:
+    """int8 GUPPI voltages ``(..., 2)`` (re, im) → real/imag float pair.
+
+    Returns separate real and imaginary parts rather than a complex dtype so
+    the FIR stage runs real-valued on the VPU/MXU; the FFT stage recombines.
+    """
+    v = voltages.astype(dtype)
+    return v[..., 0], v[..., 1]
+
+
+def pfb_frontend(
+    x: jax.Array,
+    coeffs: jax.Array,
+) -> jax.Array:
+    """Polyphase FIR: frame ``x`` (..., ntime) into windows of ``nfft`` and
+    produce tap-weighted frame sums ``(..., nframes, nfft)`` where
+    ``nframes = ntime//nfft - ntap + 1``.
+
+    ``ntime`` must be a multiple of ``nfft``.  Works on real or complex
+    inputs (applied separately to re/im keeps everything real).
+    """
+    ntap, nfft = coeffs.shape
+    ntime = x.shape[-1]
+    if ntime % nfft:
+        raise ValueError(f"pfb_frontend: ntime={ntime} not a multiple of nfft={nfft}")
+    nblk = ntime // nfft
+    nframes = nblk - ntap + 1
+    if nframes < 1:
+        raise ValueError(f"pfb_frontend: need >= {ntap} blocks of {nfft}, got {nblk}")
+    blocks = x.reshape(x.shape[:-1] + (nblk, nfft))
+    # ntap is tiny (4): unrolled shifted-slice sum; XLA fuses this into one
+    # vectorized pass, no gather needed.
+    acc = coeffs[0] * blocks[..., 0:nframes, :]
+    for k in range(1, ntap):
+        acc = acc + coeffs[k] * blocks[..., k : k + nframes, :]
+    return acc
+
+
+def _four_step_factors(n: int) -> Tuple[int, int]:
+    """Split n = n1*n2 with n1, n2 as close as possible (prefer powers of 2)."""
+    if n & (n - 1) == 0:  # power of two
+        p = n.bit_length() - 1
+        n1 = 1 << (p // 2)
+        return n1, n // n1
+    n1 = int(math.isqrt(n))
+    while n % n1:
+        n1 -= 1
+    return n1, n // n1
+
+
+def fft(z: jax.Array, *, method: str = "auto") -> jax.Array:
+    """FFT along the last axis, TPU-shaped.
+
+    ``method``:
+      - ``"direct"``: one ``jnp.fft.fft`` call.
+      - ``"four_step"``: N = N1·N2 decomposition — two batched small FFTs +
+        twiddle multiply + transpose.  This keeps every sub-FFT's working set
+        VMEM-sized and its batch MXU/VPU-friendly; required for the 1M-point
+        hi-res product (SURVEY.md §7 "hard parts").
+      - ``"auto"``: direct for N <= 8192, four-step above.
+    """
+    n = z.shape[-1]
+    if method == "auto":
+        method = "direct" if n <= _DIRECT_FFT_MAX else "four_step"
+    if method == "direct":
+        return jnp.fft.fft(z)
+    if method != "four_step":
+        raise ValueError(f"unknown fft method {method!r}")
+    n1, n2 = _four_step_factors(n)
+    if n1 == 1:
+        return jnp.fft.fft(z)
+    # x[n] with n = N2*j1 + j2  →  view (n1, n2): rows index j1.
+    x = z.reshape(z.shape[:-1] + (n1, n2))
+    # Stage 1: length-N1 FFTs down the columns (axis -2).
+    a = jnp.fft.fft(x, axis=-2)
+    # Twiddle W_N^{j2*k1}: shape (n1, n2) (k1 rows, j2 cols).
+    k1 = np.arange(n1).reshape(n1, 1)
+    j2 = np.arange(n2).reshape(1, n2)
+    tw = np.exp(-2j * np.pi * (k1 * j2) / n).astype(np.complex64)
+    a = a * jnp.asarray(tw)
+    # Stage 2: length-N2 FFTs along the rows; X[k1 + N1*k2] = b[k1, k2].
+    b = jnp.fft.fft(a, axis=-1)
+    return jnp.swapaxes(b, -1, -2).reshape(z.shape)
+
+
+def detect_stokes(spec: jax.Array, stokes: str) -> jax.Array:
+    """Detect ``spec`` (..., npol, nframes, nfft) complex → power products
+    (..., nif, nframes, nfft) float32.
+
+    Products (rawspec conventions, SURVEY.md §0):
+      - ``"I"``:    |X|² + |Y|²                       (nif=1)
+      - ``"XX"``/``"YY"``: single-pol power           (nif=1)
+      - ``"XXYY"``: [|X|², |Y|²]                      (nif=2)
+      - ``"full"``: [|X|², |Y|², Re(XY*), Im(XY*)]    (nif=4)
+      - ``"IQUV"``: Stokes parameters                 (nif=4)
+    Single-pol input only supports total power.
+    """
+    npol = spec.shape[-3]
+    if npol == 1:
+        if stokes not in ("I", "XX"):
+            raise ValueError(f"stokes={stokes!r} needs 2 pols, got 1")
+        p = (spec.real**2 + spec.imag**2)[..., 0, :, :]
+        return p[..., None, :, :]
+    xs = spec[..., 0, :, :]
+    ys = spec[..., 1, :, :]
+    xx = xs.real**2 + xs.imag**2
+    yy = ys.real**2 + ys.imag**2
+    if stokes == "I":
+        return (xx + yy)[..., None, :, :]
+    if stokes == "XX":
+        return xx[..., None, :, :]
+    if stokes == "YY":
+        return yy[..., None, :, :]
+    if stokes == "XXYY":
+        return jnp.stack([xx, yy], axis=-3)
+    xy = xs * jnp.conj(ys)
+    if stokes == "full":
+        return jnp.stack([xx, yy, xy.real, xy.imag], axis=-3)
+    if stokes == "IQUV":
+        return jnp.stack(
+            [xx + yy, xx - yy, 2 * xy.real, -2 * xy.imag], axis=-3
+        )
+    raise ValueError(f"unknown stokes {stokes!r}")
+
+
+def integrate(power: jax.Array, nint: int) -> jax.Array:
+    """Sum groups of ``nint`` consecutive frames (axis -2)."""
+    if nint <= 1:
+        return power
+    nframes = power.shape[-2]
+    if nframes % nint:
+        raise ValueError(f"integrate: nint={nint} does not divide nframes={nframes}")
+    shape = power.shape[:-2] + (nframes // nint, nint, power.shape[-1])
+    return power.reshape(shape).sum(axis=-2)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("nfft", "ntap", "nint", "stokes", "fft_method"),
+)
+def channelize(
+    voltages: jax.Array,
+    coeffs: jax.Array,
+    *,
+    nfft: int,
+    ntap: int = 4,
+    nint: int = 1,
+    stokes: str = "I",
+    fft_method: str = "auto",
+) -> jax.Array:
+    """The full single-chip reduction: int8 voltage block → filterbank slab.
+
+    Args:
+      voltages: int8 ``(nchan_coarse, ntime, npol, 2)`` (GuppiRaw.read_block
+        layout, blit/io/guppi.py) with ``ntime`` a multiple of ``nfft`` and
+        ``ntime//nfft >= ntap + nint - 1``.
+      coeffs: ``(ntap, nfft)`` PFB prototype from :func:`pfb_coeffs`.
+      nfft: fine channels per coarse channel (the rawspec product size; 2**20
+        for the hi-res product).
+      nint: spectra integrated per output sample.
+      stokes: detection product (see :func:`detect_stokes`).
+
+    Returns:
+      float32 ``(ntime_out, nif, nchan_coarse*nfft)`` in blit's canonical
+      ``(time, pol, chan)`` layout — channel fastest, fine channels fftshifted
+      within each coarse channel so the DC artifact sits at fine index
+      ``nfft//2`` (despike parity, blit/ops/despike.py).
+    """
+    nchan, _, npol, _ = voltages.shape
+    re, im = dequantize(voltages)  # (nchan, ntime, npol) each
+    re = jnp.moveaxis(re, -1, 1)  # (nchan, npol, ntime)
+    im = jnp.moveaxis(im, -1, 1)
+    fr = pfb_frontend(re, coeffs)  # (nchan, npol, nframes, nfft) real
+    fi = pfb_frontend(im, coeffs)
+    spec = fft(jax.lax.complex(fr, fi), method=fft_method)
+    spec = jnp.fft.fftshift(spec, axes=-1)
+    power = detect_stokes(spec, stokes)  # (nchan, nif, nframes, nfft)
+    power = integrate(power, nint)  # (nchan, nif, ntime_out, nfft)
+    # → (ntime_out, nif, nchan*nfft), channel fastest.
+    out = jnp.transpose(power, (2, 1, 0, 3))
+    return out.reshape(out.shape[0], out.shape[1], nchan * nfft)
+
+
+def channelize_np(
+    voltages: np.ndarray,
+    coeffs: np.ndarray,
+    *,
+    nfft: int,
+    ntap: int = 4,
+    nint: int = 1,
+    stokes: str = "I",
+) -> np.ndarray:
+    """NumPy golden-reference implementation of :func:`channelize` (tests)."""
+    v = voltages.astype(np.float32)
+    z = v[..., 0] + 1j * v[..., 1]  # (nchan, ntime, npol)
+    z = np.moveaxis(z, -1, 1)  # (nchan, npol, ntime)
+    nchan, npol, ntime = z.shape
+    nblk = ntime // nfft
+    nframes = nblk - ntap + 1
+    blocks = z.reshape(nchan, npol, nblk, nfft)
+    frames = np.zeros((nchan, npol, nframes, nfft), dtype=np.complex64)
+    for k in range(ntap):
+        frames += coeffs[k] * blocks[:, :, k : k + nframes, :]
+    spec = np.fft.fftshift(np.fft.fft(frames, axis=-1), axes=-1)
+    xs, ys = (spec[:, 0], spec[:, 1]) if npol == 2 else (spec[:, 0], spec[:, 0])
+    xx = (xs.real**2 + xs.imag**2).astype(np.float32)
+    yy = (ys.real**2 + ys.imag**2).astype(np.float32)
+    if stokes == "I":
+        prods = [xx + yy] if npol == 2 else [xx]
+    elif stokes == "XX":
+        prods = [xx]
+    elif stokes == "YY":
+        prods = [yy]
+    elif stokes == "XXYY":
+        prods = [xx, yy]
+    elif stokes in ("full", "IQUV"):
+        xy = xs * np.conj(ys)
+        if stokes == "full":
+            prods = [xx, yy, xy.real.astype(np.float32), xy.imag.astype(np.float32)]
+        else:
+            prods = [
+                xx + yy,
+                xx - yy,
+                (2 * xy.real).astype(np.float32),
+                (-2 * xy.imag).astype(np.float32),
+            ]
+    else:
+        raise ValueError(stokes)
+    power = np.stack(prods, axis=1)  # (nchan, nif, nframes, nfft)
+    if nint > 1:
+        power = power.reshape(
+            nchan, power.shape[1], nframes // nint, nint, nfft
+        ).sum(axis=3)
+    out = np.transpose(power, (2, 1, 0, 3))
+    return np.ascontiguousarray(out.reshape(out.shape[0], out.shape[1], nchan * nfft))
+
+
+def output_header(
+    raw_header: dict,
+    *,
+    nfft: int,
+    nint: int,
+    stokes: str = "I",
+) -> dict:
+    """Filterbank header for the channelized product, derived from a GUPPI
+    RAW block header (rawspec-equivalent metadata path).
+
+    Frequency mapping: coarse channel c (of OBSNCHAN, center frequencies
+    spanning OBSBW around OBSFREQ) yields nfft fine channels, fftshifted so
+    fine index f maps to offset ``(f - nfft/2) * chan_bw/nfft`` from the
+    coarse center.  With the GBT convention OBSBW < 0, channel 0 is the
+    highest frequency and ``foff`` is negative (SURVEY.md §0).
+    """
+    obsnchan = int(raw_header["OBSNCHAN"])
+    obsfreq = float(raw_header["OBSFREQ"])
+    obsbw = float(raw_header["OBSBW"])
+    tbin = float(raw_header.get("TBIN", 0.0) or 0.0)
+    chan_bw = obsbw / obsnchan
+    foff = chan_bw / nfft
+    # Center frequency of coarse channel 0:
+    c0 = obsfreq - obsbw / 2 + chan_bw / 2
+    # Fine channel 0 of coarse 0 sits nfft/2 fine-widths below its center:
+    fch1 = c0 - (nfft / 2) * foff
+    return {
+        "fch1": fch1,
+        "foff": foff,
+        "nchans": obsnchan * nfft,
+        "nifs": STOKES_NIF[stokes],
+        "tsamp": tbin * nfft * nint,
+        "nbits": 32,
+        "nfpc": nfft,
+        "source_name": raw_header.get("SRC_NAME", ""),
+        "tstart": _raw_tstart_mjd(raw_header),
+    }
+
+
+def _raw_tstart_mjd(hdr: dict) -> float:
+    imjd = float(hdr.get("STT_IMJD", 0))
+    smjd = float(hdr.get("STT_SMJD", 0))
+    offs = float(hdr.get("STT_OFFS", 0))
+    return imjd + (smjd + offs) / 86400.0

@@ -1,0 +1,180 @@
+"""Streaming GUPPI RAW → filterbank reduction driver.
+
+Host-side orchestration of the single-chip compute core
+(:mod:`blit.ops.channelize`): reads voltage blocks, maintains the PFB state
+across block boundaries (the overlap/edge-sample interaction called out as a
+hard part in SURVEY.md §7), feeds fixed-shape chunks to the jitted reduction,
+and writes SIGPROC ``.fil`` or FBH5 ``.h5`` products — the rawspec-equivalent
+stage the reference assumes has already run on each ``blc*`` node
+(SURVEY.md §0 "File products").
+
+Design:
+
+- Every chunk handed to the device has the same static shape, so XLA compiles
+  the reduction exactly once and the steady state is pure streaming.
+- A chunk of ``chunk_frames + ntap - 1`` gross blocks of ``nfft`` samples
+  yields ``chunk_frames`` PFB frames; the buffer then advances by
+  ``chunk_frames * nfft`` samples, keeping ``(ntap-1) * nfft`` as filter
+  state — frame continuity across chunks is exact (golden-tested against a
+  whole-file reduction).
+- ``chunk_frames`` is a multiple of ``nint`` so integration never straddles a
+  chunk boundary.  Trailing samples that can't fill an integration are
+  dropped, as rawspec does.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, Optional, Tuple
+
+import numpy as np
+
+from blit.io.guppi import GuppiRaw
+from blit.ops.channelize import STOKES_NIF, channelize, output_header, pfb_coeffs
+
+log = logging.getLogger("blit.pipeline")
+
+
+@dataclass
+class ReductionStats:
+    """Throughput counters (SURVEY.md §5 metrics plan)."""
+
+    input_bytes: int = 0
+    output_frames: int = 0
+    device_seconds: float = 0.0
+    wall_seconds: float = 0.0
+
+    @property
+    def gbps(self) -> float:
+        return self.input_bytes / self.wall_seconds / 1e9 if self.wall_seconds else 0.0
+
+
+@dataclass
+class RawReducer:
+    """Configured RAW → filterbank reduction (one worker / one chip).
+
+    Product presets mirror rawspec's (SURVEY.md §0): the hi-res product is
+    ``nfft=2**20, nint=1``; the low-res ``0002`` product is small-nfft,
+    long-integration.
+    """
+
+    nfft: int
+    ntap: int = 4
+    nint: int = 1
+    stokes: str = "I"
+    window: str = "hamming"
+    fft_method: str = "auto"
+    # Output frames per device call; rounded up to a multiple of nint.
+    chunk_frames: Optional[int] = None
+    stats: ReductionStats = field(default_factory=ReductionStats)
+
+    def __post_init__(self):
+        import jax.numpy as jnp
+
+        if self.chunk_frames is None:
+            # Budget-driven default: ~8M samples per coarse channel per device
+            # call.  Small-nfft products get many frames per call (amortizes
+            # dispatch); the 1M-point hi-res product gets few (the complex64
+            # FFT intermediates are what bound HBM, not dispatch overhead).
+            budget = max(1, (1 << 23) // self.nfft)
+            self.chunk_frames = self.nint * max(1, min(64, budget) // self.nint)
+        if self.chunk_frames % self.nint:
+            self.chunk_frames += self.nint - self.chunk_frames % self.nint
+        self._coeffs = jnp.asarray(pfb_coeffs(self.ntap, self.nfft, self.window))
+
+    # -- core streaming ---------------------------------------------------
+    def _run_chunk(self, chunk: np.ndarray) -> np.ndarray:
+        import jax
+
+        t0 = time.perf_counter()
+        out = channelize(
+            jax.numpy.asarray(chunk),
+            self._coeffs,
+            nfft=self.nfft,
+            ntap=self.ntap,
+            nint=self.nint,
+            stokes=self.stokes,
+            fft_method=self.fft_method,
+        )
+        out = np.asarray(jax.block_until_ready(out))
+        self.stats.device_seconds += time.perf_counter() - t0
+        return out
+
+    def stream(self, raw: GuppiRaw) -> Iterator[np.ndarray]:
+        """Yield float32 filterbank slabs ``(nspectra, nif, nchan*nfft)``
+        covering the file gap-free (PFB state carried across blocks)."""
+        nfft, ntap, nint = self.nfft, self.ntap, self.nint
+        chunk_samps = (self.chunk_frames + ntap - 1) * nfft
+        advance = self.chunk_frames * nfft
+        t_wall = time.perf_counter()
+        buf: Optional[np.ndarray] = None
+        for _, block in raw.iter_blocks(drop_overlap=True):
+            block = np.ascontiguousarray(block)
+            self.stats.input_bytes += block.nbytes
+            buf = block if buf is None else np.concatenate([buf, block], axis=1)
+            while buf.shape[1] >= chunk_samps:
+                yield self._run_chunk(buf[:, :chunk_samps])
+                self.stats.output_frames += self.chunk_frames
+                buf = buf[:, advance:]
+        if buf is not None:
+            # Flush: whole frames remaining, rounded down to the integration.
+            frames = buf.shape[1] // nfft - ntap + 1
+            frames = (frames // nint) * nint if frames > 0 else 0
+            if frames > 0:
+                tail = buf[:, : (frames + ntap - 1) * nfft]
+                yield self._run_chunk(tail)
+                self.stats.output_frames += frames
+        self.stats.wall_seconds += time.perf_counter() - t_wall
+
+    # -- whole-file conveniences ------------------------------------------
+    def header_for(self, raw: GuppiRaw) -> Dict:
+        return output_header(
+            raw.header(0), nfft=self.nfft, nint=self.nint, stokes=self.stokes
+        )
+
+    def reduce(self, raw_path: str) -> Tuple[Dict, np.ndarray]:
+        """Reduce a whole RAW file in memory → ``(filterbank_header, data)``
+        with data shaped ``(nsamps, nif, nchans)``."""
+        raw = GuppiRaw(raw_path)
+        if raw.nblocks == 0:
+            raise ValueError(f"empty or fully truncated RAW file: {raw_path}")
+        slabs = list(self.stream(raw))
+        if slabs:
+            data = np.concatenate(slabs, axis=0)
+        else:
+            nchan = raw.header(0)["OBSNCHAN"]
+            data = np.zeros((0, STOKES_NIF[self.stokes], nchan * self.nfft), np.float32)
+        hdr = self.header_for(raw)
+        hdr["nsamps"] = data.shape[0]
+        return hdr, data
+
+    def reduce_to_file(self, raw_path: str, out_path: str) -> Dict:
+        """Reduce and write a ``.fil`` or (``.h5``) FBH5 product."""
+        hdr, data = self.reduce(raw_path)
+        if out_path.endswith((".h5", ".hdf5")):
+            from blit.io.fbh5 import write_fbh5
+
+            write_fbh5(out_path, hdr, data)
+        else:
+            from blit.io.sigproc import write_fil
+
+            write_fil(out_path, hdr, data)
+        return hdr
+
+
+# rawspec-equivalent product presets (SURVEY.md §0: products 0000/0001/0002).
+PRODUCT_PRESETS = {
+    # name: (nfft, nint)
+    "0000": (1 << 20, 1),  # hi-res: ~3 Hz channels
+    "0001": (1 << 3, 128),  # mid-res time product
+    "0002": (1 << 10, 1 << 11),  # low-res survey product
+}
+
+
+def reducer_for_product(product: str, **kw) -> RawReducer:
+    """A :class:`RawReducer` configured like rawspec's standard product
+    ``product`` ("0000" | "0001" | "0002")."""
+    nfft, nint = PRODUCT_PRESETS[product]
+    return RawReducer(nfft=nfft, nint=nint, **kw)

@@ -8,10 +8,23 @@ multi-chip hardware.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU for tests even when the session env points JAX at real hardware
+# (e.g. JAX_PLATFORMS=axon under the TPU tunnel): the suite runs on the
+# virtual 8-device mesh; benchmarks (bench.py) use the real chip.  The
+# sitecustomize may have imported jax already, so the env var alone is not
+# enough — update the live config too (backends are not initialized yet at
+# conftest-import time, so this still takes effect).
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import sys
+
+if "jax" in sys.modules:  # sitecustomize already imported jax
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

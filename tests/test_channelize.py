@@ -1,0 +1,175 @@
+"""Golden-value tests for the RAW → filterbank reduction core
+(blit/ops/channelize.py) against NumPy references, per SURVEY.md §4."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from blit.ops import channelize as ch  # noqa: E402
+
+
+def make_voltages(nchan=4, ntime=8 * 256, npol=2, seed=0, tone=None, nfft=256):
+    rng = np.random.default_rng(seed)
+    v = rng.integers(-32, 32, size=(nchan, ntime, npol, 2), dtype=np.int8)
+    if tone is not None:
+        chan, fine = tone
+        t = np.arange(ntime)
+        # complex tone at fine-channel offset `fine` (fftshifted index)
+        f = (fine - nfft // 2) / nfft
+        z = 30 * np.exp(2j * np.pi * f * t)
+        v[chan, :, :, 0] += z.real.astype(np.int8)[:, None]
+        v[chan, :, :, 1] += z.imag.astype(np.int8)[:, None]
+    return v
+
+
+class TestFFT:
+    def test_four_step_matches_direct(self):
+        rng = np.random.default_rng(1)
+        z = (rng.standard_normal((3, 1024)) + 1j * rng.standard_normal((3, 1024))).astype(
+            np.complex64
+        )
+        a = ch.fft(jnp.asarray(z), method="four_step")
+        b = np.fft.fft(z)
+        np.testing.assert_allclose(np.asarray(a), b, rtol=2e-4, atol=2e-3)
+
+    def test_four_step_large_pow2(self):
+        rng = np.random.default_rng(2)
+        n = 1 << 16
+        z = (rng.standard_normal(n) + 1j * rng.standard_normal(n)).astype(np.complex64)
+        a = np.asarray(ch.fft(jnp.asarray(z), method="four_step"))
+        b = np.fft.fft(z)
+        assert np.max(np.abs(a - b)) / np.max(np.abs(b)) < 1e-4
+
+    def test_four_step_non_pow2(self):
+        rng = np.random.default_rng(3)
+        n = 12 * 25
+        z = (rng.standard_normal((2, n)) + 1j * rng.standard_normal((2, n))).astype(
+            np.complex64
+        )
+        a = np.asarray(ch.fft(jnp.asarray(z), method="four_step"))
+        np.testing.assert_allclose(a, np.fft.fft(z), rtol=1e-3, atol=1e-3)
+
+    def test_factors(self):
+        assert ch._four_step_factors(1 << 20) == (1 << 10, 1 << 10)
+        n1, n2 = ch._four_step_factors(300)
+        assert n1 * n2 == 300
+
+
+class TestPFB:
+    def test_coeffs_shape_and_dc_gain(self):
+        h = ch.pfb_coeffs(4, 64)
+        assert h.shape == (4, 64)
+        assert abs(h.sum() - 1.0) < 1e-6
+
+    def test_frontend_frame_count(self):
+        x = jnp.ones((2, 8 * 32))
+        h = jnp.asarray(ch.pfb_coeffs(4, 32))
+        y = ch.pfb_frontend(x, h)
+        assert y.shape == (2, 5, 32)
+
+    def test_rect_window_single_tap_is_framing(self):
+        # ntap=1 rect window = plain framing (scaled by 1/nfft via DC norm).
+        x = np.arange(64, dtype=np.float32)
+        h = ch.pfb_coeffs(1, 16, window="rect")
+        y = np.asarray(ch.pfb_frontend(jnp.asarray(x), jnp.asarray(h)))
+        np.testing.assert_allclose(y, x.reshape(4, 16) * h[0], rtol=1e-6)
+
+
+class TestChannelize:
+    @pytest.mark.parametrize("stokes", ["I", "XXYY", "full", "IQUV"])
+    def test_matches_numpy_reference(self, stokes):
+        nfft, ntap, nint = 64, 4, 2
+        v = make_voltages(nchan=3, ntime=(ntap - 1 + 2 * nint) * nfft)
+        h = ch.pfb_coeffs(ntap, nfft)
+        got = np.asarray(
+            ch.channelize(
+                jnp.asarray(v), jnp.asarray(h), nfft=nfft, ntap=ntap, nint=nint,
+                stokes=stokes,
+            )
+        )
+        want = ch.channelize_np(v, h, nfft=nfft, ntap=ntap, nint=nint, stokes=stokes)
+        assert got.shape == want.shape == (2, ch.STOKES_NIF[stokes], 3 * nfft)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-2)
+
+    def test_tone_lands_in_right_fine_channel(self):
+        nfft = 128
+        v = make_voltages(nchan=2, ntime=8 * nfft, tone=(1, 96), nfft=nfft, seed=5)
+        h = ch.pfb_coeffs(4, nfft)
+        out = np.asarray(
+            ch.channelize(jnp.asarray(v), jnp.asarray(h), nfft=nfft, nint=5)
+        )
+        spectrum = out[0, 0]
+        # global fine index = coarse*nfft + fine
+        assert spectrum.argmax() == 1 * nfft + 96
+
+    def test_dc_tone_lands_at_despike_index(self):
+        # A DC offset concentrates at fftshifted index nfft//2 — the exact
+        # fine channel blit.ops.despike repairs (src/gbt.jl:101-111 parity).
+        nfft = 64
+        v = np.zeros((1, 8 * nfft, 2, 2), dtype=np.int8)
+        v[..., 0] = 20
+        h = ch.pfb_coeffs(4, nfft)
+        out = np.asarray(
+            ch.channelize(jnp.asarray(v), jnp.asarray(h), nfft=nfft, nint=5)
+        )
+        assert out[0, 0].argmax() == nfft // 2
+
+    def test_four_step_equals_direct_end_to_end(self):
+        nfft = 1024
+        v = make_voltages(nchan=1, ntime=5 * nfft)
+        h = ch.pfb_coeffs(4, nfft)
+        a = np.asarray(
+            ch.channelize(
+                jnp.asarray(v), jnp.asarray(h), nfft=nfft, nint=2, fft_method="direct"
+            )
+        )
+        b = np.asarray(
+            ch.channelize(
+                jnp.asarray(v), jnp.asarray(h), nfft=nfft, nint=2,
+                fft_method="four_step",
+            )
+        )
+        np.testing.assert_allclose(a, b, rtol=1e-3, atol=10.0)
+        rel = np.abs(a - b).max() / np.abs(a).max()
+        assert rel < 1e-4
+
+    def test_single_pol(self):
+        v = make_voltages(nchan=2, ntime=5 * 32, npol=1)
+        h = ch.pfb_coeffs(4, 32)
+        out = np.asarray(ch.channelize(jnp.asarray(v), jnp.asarray(h), nfft=32))
+        assert out.shape == (2, 1, 64)
+        with pytest.raises(ValueError):
+            ch.detect_stokes(jnp.zeros((1, 1, 2, 4), dtype=jnp.complex64), "IQUV")
+
+
+class TestOutputHeader:
+    RAW = {
+        "OBSNCHAN": 64,
+        "OBSFREQ": 1500.0,
+        "OBSBW": -187.5,
+        "TBIN": 64 / 187.5e6,
+        "SRC_NAME": "J1234+56",
+        "STT_IMJD": 59000,
+        "STT_SMJD": 43200,
+        "STT_OFFS": 0.0,
+    }
+
+    def test_header_fields(self):
+        hdr = ch.output_header(self.RAW, nfft=1024, nint=8, stokes="full")
+        assert hdr["nchans"] == 64 * 1024
+        assert hdr["nifs"] == 4
+        assert hdr["nfpc"] == 1024
+        assert hdr["foff"] == pytest.approx(-187.5 / 64 / 1024)
+        assert hdr["tsamp"] == pytest.approx(64 / 187.5e6 * 1024 * 8)
+        assert hdr["tstart"] == pytest.approx(59000.5)
+
+    def test_band_edges(self):
+        # The nchans fine channels must span exactly OBSBW centered on OBSFREQ.
+        nfft = 256
+        hdr = ch.output_header(self.RAW, nfft=nfft, nint=1)
+        freqs = hdr["fch1"] + hdr["foff"] * np.arange(hdr["nchans"])
+        assert freqs.mean() == pytest.approx(1500.0, abs=abs(hdr["foff"]))
+        span = abs(freqs[-1] - freqs[0]) + abs(hdr["foff"])
+        assert span == pytest.approx(187.5)

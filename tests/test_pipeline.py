@@ -1,0 +1,115 @@
+"""End-to-end RAW → filterbank pipeline tests (blit/pipeline.py): streaming
+chunking vs whole-file golden reduction, overlap handling, product output."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from blit.io.guppi import GuppiRaw  # noqa: E402
+from blit.io.sigproc import read_fil_data  # noqa: E402
+from blit.ops.channelize import channelize_np, pfb_coeffs  # noqa: E402
+from blit.pipeline import RawReducer, reducer_for_product  # noqa: E402
+from blit.testing import synth_raw  # noqa: E402
+
+
+def whole_file_reference(raw_path, nfft, ntap, nint, stokes="I"):
+    """Golden: concatenate the overlap-trimmed stream and reduce in one shot
+    with the NumPy reference implementation."""
+    raw = GuppiRaw(raw_path)
+    stream = np.concatenate(
+        [blk for _, blk in raw.iter_blocks(drop_overlap=True)], axis=1
+    )
+    frames = stream.shape[1] // nfft - ntap + 1
+    frames = (frames // nint) * nint
+    usable = (frames + ntap - 1) * nfft
+    h = pfb_coeffs(ntap, nfft)
+    return channelize_np(
+        stream[:, :usable], h, nfft=nfft, ntap=ntap, nint=nint, stokes=stokes
+    )
+
+
+class TestStreaming:
+    @pytest.mark.parametrize("overlap", [0, 64])
+    def test_streaming_matches_whole_file(self, tmp_path, overlap):
+        # Chunked streaming with PFB state carry must equal the one-shot
+        # reduction of the gap-free stream — block/chunk boundaries invisible.
+        p = str(tmp_path / "x.raw")
+        synth_raw(p, nblocks=4, obsnchan=4, ntime_per_block=1024 + overlap,
+                  overlap=overlap, tone_chan=2)
+        red = RawReducer(nfft=128, nint=2, chunk_frames=4)
+        hdr, data = red.reduce(p)
+        want = whole_file_reference(p, nfft=128, ntap=4, nint=2)
+        assert data.shape == want.shape
+        np.testing.assert_allclose(data, want, rtol=1e-4, atol=0.5)
+        rel = np.abs(data - want).max() / want.max()
+        assert rel < 1e-4
+
+    def test_chunk_frames_rounds_to_nint(self):
+        red = RawReducer(nfft=64, nint=6, chunk_frames=8)
+        assert red.chunk_frames % 6 == 0
+
+    def test_stats_track_input_bytes(self, tmp_path):
+        p = str(tmp_path / "x.raw")
+        _, blocks = synth_raw(p, nblocks=2, obsnchan=2, ntime_per_block=512)
+        red = RawReducer(nfft=64, nint=1)
+        red.reduce(p)
+        assert red.stats.input_bytes == sum(b.nbytes for b in blocks)
+        assert red.stats.wall_seconds > 0
+        assert red.stats.gbps > 0
+
+
+class TestProducts:
+    def test_reduce_to_fil_roundtrip(self, tmp_path):
+        p = str(tmp_path / "x.raw")
+        synth_raw(p, nblocks=2, obsnchan=2, ntime_per_block=1024, tone_chan=1)
+        out = str(tmp_path / "x.rawspec.0002.fil")
+        red = RawReducer(nfft=64, nint=4, stokes="I")
+        hdr = red.reduce_to_file(p, out)
+        rhdr, data = read_fil_data(out)
+        assert rhdr["nchans"] == 2 * 64
+        assert rhdr["nifs"] == 1
+        assert data.shape[0] == hdr["nsamps"]
+        # The injected tone (chan 1, freq 0.25) must dominate its fine channel.
+        spec = np.asarray(data).sum(axis=0)[0]
+        assert spec.argmax() == 64 + 32 + 16  # coarse 1, fftshift(0.25*64)=48
+
+    def test_reduce_to_fbh5_roundtrip(self, tmp_path):
+        h5py = pytest.importorskip("h5py")  # noqa: F841
+        from blit.io.fbh5 import read_fbh5_data, read_fbh5_header
+
+        p = str(tmp_path / "x.raw")
+        synth_raw(p, nblocks=2, obsnchan=2, ntime_per_block=1024)
+        out = str(tmp_path / "x.rawspec.0002.h5")
+        red = RawReducer(nfft=64, nint=4)
+        red.reduce_to_file(p, out)
+        hdr = read_fbh5_header(out)
+        data = read_fbh5_data(out)
+        assert hdr["nchans"] == 128 and data.ndim == 3
+
+    def test_header_frequency_axis(self, tmp_path):
+        p = str(tmp_path / "x.raw")
+        synth_raw(p, nblocks=1, obsnchan=4, ntime_per_block=512, obsbw=-187.5)
+        red = RawReducer(nfft=64, nint=1)
+        hdr, _ = red.reduce(p)
+        assert hdr["foff"] == pytest.approx(-187.5 / 4 / 64)
+        freqs = hdr["fch1"] + hdr["foff"] * np.arange(hdr["nchans"])
+        assert freqs.mean() == pytest.approx(8437.5, abs=abs(hdr["foff"]))
+
+    def test_product_presets(self):
+        red = reducer_for_product("0001")
+        assert (red.nfft, red.nint) == (8, 128)
+
+
+class TestEdgeCases:
+    def test_empty_raw_file_raises(self, tmp_path):
+        p = tmp_path / "empty.raw"
+        p.write_bytes(b"")
+        with pytest.raises(ValueError, match="empty"):
+            RawReducer(nfft=64).reduce(str(p))
+
+    def test_hires_default_chunk_is_hbm_sized(self):
+        red = RawReducer(nfft=1 << 20, nint=1)
+        assert red.chunk_frames <= 8  # budget-scaled, not the small-nfft 64
+        red2 = RawReducer(nfft=1024, nint=1)
+        assert red2.chunk_frames == 64
