@@ -1,0 +1,171 @@
+"""Python bindings for the native bitshuffle+LZ4 codec (blit/native/
+bitshuffle.cc) — the replacement for the reference's H5Zbitshuffle.jl
+dependency (SURVEY.md §2.2-2.3).
+
+Used by :mod:`blit.io.fbh5` for direct-chunk FBH5 compression: chunks carry
+HDF5 filter id 32008 in the dataset's filter pipeline (so external tools
+with the standard bitshuffle plugin read our files), while blit itself
+encodes/decodes chunks through this codec and h5py's
+``read_direct_chunk``/``write_direct_chunk`` — no HDF5 plugin machinery
+needed.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional
+
+import numpy as np
+
+from blit.io.native import lib_path
+
+BITSHUFFLE_FILTER_ID = 32008
+H5_COMPRESS_LZ4 = 2
+# (major, minor) the upstream filter stamps into cd_values.
+_FILTER_VERSION = (0, 4)
+
+_lib: Optional[ctypes.CDLL] = None
+_lib_missing = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_missing
+    if _lib is not None or _lib_missing:
+        return _lib
+    p = lib_path("libblit_bshuf.so")
+    if p is None:
+        _lib_missing = True
+        return None
+    lib = ctypes.CDLL(p)
+    lib.blit_bshuf_default_block_size.restype = ctypes.c_size_t
+    lib.blit_bshuf_default_block_size.argtypes = [ctypes.c_size_t]
+    lib.blit_bshuf_shuffle.restype = ctypes.c_int
+    lib.blit_bshuf_shuffle.argtypes = [ctypes.c_void_p] * 2 + [ctypes.c_size_t] * 2
+    lib.blit_bshuf_unshuffle.restype = ctypes.c_int
+    lib.blit_bshuf_unshuffle.argtypes = [ctypes.c_void_p] * 2 + [ctypes.c_size_t] * 2
+    lib.blit_bshuf_compress_bound.restype = ctypes.c_int64
+    lib.blit_bshuf_compress_bound.argtypes = [ctypes.c_size_t] * 3
+    lib.blit_bshuf_compress_lz4.restype = ctypes.c_int64
+    lib.blit_bshuf_compress_lz4.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t, ctypes.c_size_t,
+        ctypes.c_size_t,
+    ]
+    lib.blit_bshuf_decompress_lz4.restype = ctypes.c_int64
+    lib.blit_bshuf_decompress_lz4.argtypes = [
+        ctypes.c_void_p, ctypes.c_size_t, ctypes.c_void_p, ctypes.c_size_t,
+        ctypes.c_size_t,
+    ]
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    """True when the native codec library is built and loadable."""
+    return _load() is not None
+
+
+def default_block_size(elem_size: int) -> int:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("bitshuffle codec unavailable: build blit/native")
+    return lib.blit_bshuf_default_block_size(elem_size)
+
+
+def bitshuffle(a: np.ndarray) -> np.ndarray:
+    """Bit-transpose (no compression) — element count must be a multiple of
+    8.  Exposed mainly for tests against the NumPy model."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("bitshuffle codec unavailable: build blit/native")
+    a = np.ascontiguousarray(a)
+    out = np.empty(a.nbytes, np.uint8)
+    rc = lib.blit_bshuf_shuffle(
+        a.ctypes.data, out.ctypes.data, a.size, a.itemsize
+    )
+    if rc:
+        raise ValueError(f"bitshuffle failed (rc={rc}); size must be 8k")
+    return out
+
+
+def bitunshuffle(buf: np.ndarray, dtype, count: int) -> np.ndarray:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("bitshuffle codec unavailable: build blit/native")
+    dtype = np.dtype(dtype)
+    buf = np.ascontiguousarray(np.frombuffer(buf, np.uint8))
+    if buf.size != count * dtype.itemsize:
+        raise ValueError(
+            f"bitunshuffle: buffer holds {buf.size} bytes, "
+            f"need exactly {count * dtype.itemsize}"
+        )
+    out = np.empty(count, dtype)
+    rc = lib.blit_bshuf_unshuffle(
+        buf.ctypes.data, out.ctypes.data, count, dtype.itemsize
+    )
+    if rc:
+        raise ValueError(f"bitunshuffle failed (rc={rc})")
+    return out
+
+
+def compress_chunk(a: np.ndarray, block_size: int = 0) -> bytes:
+    """Encode one HDF5 chunk's worth of data into the bitshuffle-LZ4 wire
+    format (the exact payload ``write_direct_chunk`` stores)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("bitshuffle codec unavailable: build blit/native")
+    a = np.ascontiguousarray(a)
+    bound = lib.blit_bshuf_compress_bound(a.size, a.itemsize, block_size)
+    out = np.empty(bound, np.uint8)
+    n = lib.blit_bshuf_compress_lz4(
+        a.ctypes.data, out.ctypes.data, a.size, a.itemsize, block_size
+    )
+    if n < 0:
+        raise ValueError(f"bitshuffle compress failed (rc={n})")
+    return out[:n].tobytes()
+
+
+def decompress_chunk(payload: bytes, dtype, count: int) -> np.ndarray:
+    """Decode one chunk payload back to ``count`` elements of ``dtype``."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("bitshuffle codec unavailable: build blit/native")
+    dtype = np.dtype(dtype)
+    src = np.frombuffer(payload, np.uint8)
+    out = np.empty(count, dtype)
+    n = lib.blit_bshuf_decompress_lz4(
+        src.ctypes.data, len(payload), out.ctypes.data, count, dtype.itemsize
+    )
+    if n < 0:
+        raise ValueError(f"bitshuffle decompress failed (rc={n})")
+    return out
+
+
+def filter_cd_values(elem_size: int, block_size: int = 0) -> tuple:
+    """cd_values stamped into the HDF5 filter pipeline, matching the
+    upstream bitshuffle plugin's convention."""
+    return (
+        _FILTER_VERSION[0],
+        _FILTER_VERSION[1],
+        elem_size,
+        block_size,
+        H5_COMPRESS_LZ4,
+    )
+
+
+# -- NumPy model (golden reference for the C++ bit transpose) -------------
+
+
+def bitshuffle_np(a: np.ndarray) -> np.ndarray:
+    """Pure-NumPy bitshuffle model: out row (byte_pos*8 + bit), bit 0 = LSB;
+    within a row, bit j of byte i belongs to element 8i+j."""
+    a = np.ascontiguousarray(a)
+    nelem, elem_size = a.size, a.itemsize
+    if nelem % 8:
+        raise ValueError("element count must be a multiple of 8")
+    by = a.view(np.uint8).reshape(nelem, elem_size)  # [elem][byte]
+    # bits[e, b, k] = bit k (LSB-first) of byte b of element e
+    bits = (by[:, :, None] >> np.arange(8)) & 1
+    # target layout: rows [byte_pos][bit], columns element; bit j of out byte
+    # i = element 8i+j → packbits with bitorder little over the element axis.
+    rows = bits.transpose(1, 2, 0).reshape(elem_size * 8, nelem)
+    return np.packbits(rows, axis=-1, bitorder="little").reshape(-1)

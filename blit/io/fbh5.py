@@ -3,8 +3,13 @@
 Replaces HDF5.jl + H5Zbitshuffle.jl usage (reference:
 src/gbtworkerfunctions.jl:141-155, 179-189).  An FBH5 file holds one ``data``
 dataset shaped ``(nsamps, nifs, nchans)`` whose attributes carry the
-filterbank header; BL files are bitshuffle+LZ4 compressed (decoded natively
-when ``blit/native``'s HDF5 filter plugin is built, see blit/io/native.py).
+filterbank header; BL files are bitshuffle+LZ4 compressed.
+
+Bitshuffle support does not use HDF5's filter-plugin machinery at all:
+chunks are encoded/decoded by blit's native C++ codec (blit/io/bshuf.py →
+blit/native/bitshuffle.cc) through h5py's direct-chunk I/O, while the
+dataset's filter pipeline still carries the standard filter id 32008 so
+files interoperate with external tools that have the upstream plugin.
 """
 
 from __future__ import annotations
@@ -15,11 +20,63 @@ import h5py
 import numpy as np
 
 from blit.config import nfpc_from_foff
-from blit.io import native as _native
+from blit.io.bshuf import BITSHUFFLE_FILTER_ID
 
-BITSHUFFLE_FILTER_ID = 32008  # registered HDF5 filter id for bitshuffle
 
-_native.ensure_hdf5_plugin_path()
+def _bitshuffle_cd_values(ds) -> Optional[Tuple]:
+    """cd_values if the dataset's filter pipeline contains bitshuffle."""
+    try:
+        plist = ds.id.get_create_plist()
+        for i in range(plist.get_nfilters()):
+            code, _flags, cd, _name = plist.get_filter(i)
+            if code == BITSHUFFLE_FILTER_ID:
+                return tuple(cd)
+    except Exception:  # noqa: BLE001 - treat unreadable pipelines as plain
+        return None
+    return None
+
+
+def _needs_manual_bitshuffle(ds) -> bool:
+    return (
+        _bitshuffle_cd_values(ds) is not None
+        and not h5py.h5z.filter_avail(BITSHUFFLE_FILTER_ID)
+    )
+
+
+def _read_bitshuffle_chunks(ds, bbox: Tuple[Tuple[int, int], ...]) -> np.ndarray:
+    """Assemble the half-open bounding box ``bbox`` of a bitshuffle dataset
+    by decoding exactly the intersecting chunks with the native codec."""
+    from blit.io import bshuf
+
+    if not bshuf.available():
+        raise RuntimeError(
+            "file needs the bitshuffle codec: build blit/native (make -C blit/native)"
+        )
+    chunk = ds.chunks
+    shape = ds.shape
+    out = np.empty([hi - lo for lo, hi in bbox], ds.dtype)
+    ranges = [
+        range(lo // c * c, hi, c) for (lo, hi), c in zip(bbox, chunk)
+    ]
+    import itertools
+
+    for corner in itertools.product(*ranges):
+        _mask, payload = ds.id.read_direct_chunk(corner)
+        full = tuple(min(c, s - o) for c, s, o in zip(chunk, shape, corner))
+        # Chunks are stored at full chunk size (edge chunks padded).
+        dec = bshuf.decompress_chunk(
+            payload, ds.dtype, int(np.prod(chunk))
+        ).reshape(chunk)[tuple(slice(0, f) for f in full)]
+        src = tuple(
+            slice(max(lo - o, 0), min(hi - o, f))
+            for (lo, hi), o, f in zip(bbox, corner, full)
+        )
+        dst = tuple(
+            slice(max(o - lo, 0), max(o - lo, 0) + (s.stop - s.start))
+            for (lo, _hi), o, s in zip(bbox, corner, src)
+        )
+        out[dst] = dec[src]
+    return out
 
 
 def is_hdf5(path: str) -> bool:
@@ -82,9 +139,52 @@ def read_fbh5_data(
         ds = h5["data"]
         if idxs is not None and len(idxs) != 3:
             raise ValueError("idxs must have exactly three indices")
-        if idxs is None or all(i == slice(None) for i in idxs):
-            return ds[()]
-        return ds[idxs]
+        full = idxs is None or all(i == slice(None) for i in idxs)
+        if not _needs_manual_bitshuffle(ds):
+            return ds[()] if full else ds[idxs]
+        # Manual path: decode intersecting chunks with the native codec.
+        if idxs is None:
+            idxs = (slice(None),) * 3
+        norm = []
+        for i, n in zip(idxs, ds.shape):
+            if isinstance(i, slice):
+                norm.append(i.indices(n))
+            else:
+                j = int(i) + n if int(i) < 0 else int(i)  # h5py-style negatives
+                norm.append((j, j + 1, 1))
+        if any(step < 1 or start < 0 for start, _e, step in norm):
+            raise ValueError(
+                "bitshuffle read: negative steps / out-of-range indices unsupported"
+            )
+        bbox = tuple((start, max(stop, start)) for start, stop, _ in norm)
+        box = _read_bitshuffle_chunks(ds, bbox)
+        residual = tuple(
+            slice(None, None, step) if isinstance(i, slice) else 0
+            for i, (_s, _e, step) in zip(idxs, norm)
+        )
+        return box[residual]
+
+
+def _write_bitshuffle_chunks(ds, data: np.ndarray) -> None:
+    """Encode every chunk with the native codec and store it via
+    direct-chunk writes (edge chunks zero-padded to full chunk size, as the
+    upstream filter does)."""
+    import itertools
+
+    from blit.io import bshuf
+
+    chunk = ds.chunks
+    ranges = [range(0, s, c) for s, c in zip(data.shape, chunk)]
+    for corner in itertools.product(*ranges):
+        sl = tuple(
+            slice(o, min(o + c, s)) for o, c, s in zip(corner, chunk, data.shape)
+        )
+        block = data[sl]
+        if block.shape != chunk:
+            padded = np.zeros(chunk, data.dtype)
+            padded[tuple(slice(0, b) for b in block.shape)] = block
+            block = padded
+        ds.id.write_direct_chunk(corner, bshuf.compress_chunk(block))
 
 
 def write_fbh5(
@@ -97,10 +197,11 @@ def write_fbh5(
     """Write an FBH5 file: ``data`` dataset + header attributes.
 
     ``compression``: None | "gzip" | "bitshuffle" (bitshuffle requires the
-    native plugin from ``blit/native``; raises if unavailable).
+    native codec from ``blit/native``; raises if unbuilt).
     """
     if data.ndim != 3:
         raise ValueError("write_fbh5: data must be (nsamps, nifs, nchans)")
+    bitshuffle = False
     kw = {}
     if chunks is not None:
         kw["chunks"] = chunks
@@ -108,20 +209,32 @@ def write_fbh5(
         kw["compression"] = "gzip"
         kw.setdefault("chunks", True)
     elif compression == "bitshuffle":
-        if not h5py.h5z.filter_avail(BITSHUFFLE_FILTER_ID):
+        from blit.io import bshuf
+
+        if not bshuf.available():
             raise RuntimeError(
-                "bitshuffle HDF5 filter unavailable; build blit/native first"
+                "bitshuffle codec unavailable; build blit/native first"
             )
+        bitshuffle = True
+        kw["chunks"] = chunks or (
+            min(data.shape[0], 16), data.shape[1], data.shape[2]
+        )
         kw["compression"] = BITSHUFFLE_FILTER_ID
-        kw["compression_opts"] = (0, 2)  # block size auto, 2 = LZ4
-        kw.setdefault("chunks", (min(data.shape[0], 16), data.shape[1], data.shape[2]))
+        kw["compression_opts"] = bshuf.filter_cd_values(data.dtype.itemsize)
+        kw["allow_unknown_filter"] = True
     elif compression is not None:
         raise ValueError(f"unknown compression {compression!r}")
 
     with h5py.File(path, "w") as h5:
         h5.attrs["CLASS"] = np.bytes_(b"FILTERBANK")
         h5.attrs["VERSION"] = np.bytes_(b"1.0")
-        ds = h5.create_dataset("data", data=data, **kw)
+        if bitshuffle:
+            ds = h5.create_dataset(
+                "data", shape=data.shape, dtype=data.dtype, **kw
+            )
+            _write_bitshuffle_chunks(ds, np.ascontiguousarray(data))
+        else:
+            ds = h5.create_dataset("data", data=data, **kw)
         for k, v in header.items():
             if k in ("data_size", "nsamps"):
                 continue  # computed on read

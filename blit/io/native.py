@@ -13,7 +13,6 @@ import os
 from typing import Optional
 
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "native", "build")
-_plugin_registered = False
 
 
 def native_dir() -> str:
@@ -23,31 +22,6 @@ def native_dir() -> str:
 def lib_path(name: str) -> Optional[str]:
     p = os.path.join(native_dir(), name)
     return p if os.path.exists(p) else None
-
-
-def ensure_hdf5_plugin_path() -> bool:
-    """Make libhdf5 see blit's filter plugins (bitshuffle+LZ4).
-
-    Must run before the first h5py File open that needs the filter.  Uses the
-    HDF5 plugin-path API via h5py so it works even after HDF5_PLUGIN_PATH has
-    been read at library init.
-    """
-    global _plugin_registered
-    if _plugin_registered:
-        return True
-    d = native_dir()
-    if not os.path.isdir(d) or not any(
-        f.startswith("libblit_h5bshuf") for f in os.listdir(d)
-    ):
-        return False
-    try:
-        import h5py
-
-        h5py.h5pl.prepend(d.encode())
-        _plugin_registered = True
-        return True
-    except Exception:
-        return False
 
 
 _guppi_lib = None
@@ -61,5 +35,34 @@ def guppi_lib() -> Optional[ctypes.CDLL]:
     p = lib_path("libblit_guppi.so")
     if p is None:
         return None
-    _guppi_lib = ctypes.CDLL(p)
+    lib = ctypes.CDLL(p)
+    lib.blit_guppi_pread.restype = ctypes.c_int
+    lib.blit_guppi_pread.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_uint64,
+        ctypes.c_uint64,
+        ctypes.c_void_p,
+        ctypes.c_int,
+    ]
+    _guppi_lib = lib
     return _guppi_lib
+
+
+def guppi_pread(path: str, offset: int, size: int, nthreads: int = 8):
+    """Threaded pread of ``[offset, offset+size)`` into a fresh uint8 array
+    via the native reader (blit/native/guppi.cc).  Raises ``OSError`` on
+    failure; ``RuntimeError`` if the library is unbuilt."""
+    import numpy as np
+
+    lib = guppi_lib()
+    if lib is None:
+        raise RuntimeError("native GUPPI reader unbuilt: make -C blit/native")
+    out = np.empty(size, np.uint8)
+    rc = lib.blit_guppi_pread(
+        path.encode(), offset, size, out.ctypes.data, nthreads
+    )
+    if rc:
+        import os as _os
+
+        raise OSError(-rc, _os.strerror(-rc), path)
+    return out

@@ -1,0 +1,239 @@
+// Bitshuffle + LZ4 codec for FBH5 chunks — the C++ rebuild of the
+// reference's H5Zbitshuffle dependency (SURVEY.md §2.3: bitshuffle C library
+// with SSE2/AVX2 kernels wrapped by H5Zbitshuffle.jl, Project.toml:9).
+//
+// Implements the bitshuffle on-disk format (HDF5 filter id 32008, LZ4 mode):
+//
+//   chunk payload := [u64 BE total uncompressed bytes]
+//                    [u32 BE block size in bytes]
+//                    repeat: [u32 BE compressed size][LZ4 block]
+//                    [raw leftover: (nelem % 8) * elem_size bytes]
+//
+// Each block of `block_size` elements is bit-transposed ("bitshuffled") then
+// LZ4-compressed independently.  The bit transpose layout: for a block of n
+// elements of elem_size bytes, output row (byte_pos*8 + bit) (bit 0 = LSB)
+// holds n/8 bytes; bit j of its byte i is bit `bit` of byte `byte_pos` of
+// element 8i+j.  This matches upstream bitshuffle's
+// trans_byte_elem → trans_bit_byte → trans_bitrow_eight pipeline.
+//
+// LZ4 block compression comes from the system liblz4 (stable C ABI,
+// prototypes declared below — no headers shipped in this image).
+//
+// Exposed C ABI (ctypes-consumed by blit/io/bshuf.py):
+//   blit_bshuf_shuffle / blit_bshuf_unshuffle    — bit transpose only
+//   blit_bshuf_compress_lz4 / _decompress_lz4    — full chunk codec
+//   blit_bshuf_compress_bound, blit_bshuf_default_block_size
+
+#include <cstdint>
+#include <cstring>
+#include <cstddef>
+
+extern "C" {
+// liblz4.so.1 ABI (stable since lz4 r129).
+int LZ4_compress_default(const char* src, char* dst, int srcSize, int dstCapacity);
+int LZ4_decompress_safe(const char* src, char* dst, int compressedSize, int dstCapacity);
+int LZ4_compressBound(int inputSize);
+}
+
+namespace {
+
+constexpr size_t kBlockedMult = 8;      // elements per bit-transpose unit
+constexpr size_t kTargetBlockBytes = 8192;
+constexpr size_t kMinBlockElems = 128;
+
+// 8x8 bit-matrix transpose on a little-endian u64 (Hacker's Delight 7-3).
+inline void trans_bit_8x8(uint64_t& x) {
+  uint64_t t;
+  t = (x ^ (x >> 7)) & 0x00AA00AA00AA00AAULL;
+  x = x ^ t ^ (t << 7);
+  t = (x ^ (x >> 14)) & 0x0000CCCC0000CCCCULL;
+  x = x ^ t ^ (t << 14);
+  t = (x ^ (x >> 28)) & 0x00000000F0F0F0F0ULL;
+  x = x ^ t ^ (t << 28);
+}
+
+// Bitshuffle one block: nelem must be a multiple of 8.
+// in: nelem elements of elem_size bytes; out: same byte count.
+void shuffle_block(const uint8_t* in, uint8_t* out, size_t nelem,
+                   size_t elem_size) {
+  const size_t nrow_bytes = nelem / 8;  // bytes per bit plane
+  for (size_t b = 0; b < elem_size; b++) {
+    for (size_t i = 0; i < nrow_bytes; i++) {
+      // Gather byte `b` of elements 8i..8i+7 into a u64 (byte j = elem 8i+j).
+      uint64_t x = 0;
+      for (size_t j = 0; j < 8; j++) {
+        x |= (uint64_t)in[(8 * i + j) * elem_size + b] << (8 * j);
+      }
+      trans_bit_8x8(x);
+      // After transpose, byte k of x = bit k of the 8 gathered bytes.
+      for (size_t k = 0; k < 8; k++) {
+        out[(b * 8 + k) * nrow_bytes + i] = (uint8_t)(x >> (8 * k));
+      }
+    }
+  }
+}
+
+void unshuffle_block(const uint8_t* in, uint8_t* out, size_t nelem,
+                     size_t elem_size) {
+  const size_t nrow_bytes = nelem / 8;
+  for (size_t b = 0; b < elem_size; b++) {
+    for (size_t i = 0; i < nrow_bytes; i++) {
+      uint64_t x = 0;
+      for (size_t k = 0; k < 8; k++) {
+        x |= (uint64_t)in[(b * 8 + k) * nrow_bytes + i] << (8 * k);
+      }
+      trans_bit_8x8(x);  // involution: same transpose inverts
+      for (size_t j = 0; j < 8; j++) {
+        out[(8 * i + j) * elem_size + b] = (uint8_t)(x >> (8 * j));
+      }
+    }
+  }
+}
+
+inline void store_be32(uint8_t* p, uint32_t v) {
+  p[0] = v >> 24; p[1] = v >> 16; p[2] = v >> 8; p[3] = v;
+}
+inline void store_be64(uint8_t* p, uint64_t v) {
+  for (int i = 0; i < 8; i++) p[i] = (uint8_t)(v >> (56 - 8 * i));
+}
+inline uint32_t load_be32(const uint8_t* p) {
+  return ((uint32_t)p[0] << 24) | ((uint32_t)p[1] << 16) |
+         ((uint32_t)p[2] << 8) | p[3];
+}
+inline uint64_t load_be64(const uint8_t* p) {
+  uint64_t v = 0;
+  for (int i = 0; i < 8; i++) v = (v << 8) | p[i];
+  return v;
+}
+
+}  // namespace
+
+extern "C" {
+
+size_t blit_bshuf_default_block_size(size_t elem_size) {
+  size_t bs = kTargetBlockBytes / elem_size;
+  bs = (bs / kBlockedMult) * kBlockedMult;
+  if (bs < kMinBlockElems) bs = kMinBlockElems;
+  return bs;
+}
+
+// Bit transpose only (no compression); nelem must be a multiple of 8.
+// Returns 0 on success.
+int blit_bshuf_shuffle(const void* in, void* out, size_t nelem,
+                       size_t elem_size) {
+  if (nelem % 8) return -1;
+  shuffle_block((const uint8_t*)in, (uint8_t*)out, nelem, elem_size);
+  return 0;
+}
+
+int blit_bshuf_unshuffle(const void* in, void* out, size_t nelem,
+                         size_t elem_size) {
+  if (nelem % 8) return -1;
+  unshuffle_block((const uint8_t*)in, (uint8_t*)out, nelem, elem_size);
+  return 0;
+}
+
+int64_t blit_bshuf_compress_bound(size_t nelem, size_t elem_size,
+                                  size_t block_elems) {
+  if (block_elems == 0) block_elems = blit_bshuf_default_block_size(elem_size);
+  size_t nblocks = nelem / block_elems + 2;  // + partial + slack
+  size_t block_bytes = block_elems * elem_size;
+  return 12 + (int64_t)nblocks * (4 + LZ4_compressBound((int)block_bytes)) +
+         8 * elem_size;
+}
+
+// Compress nelem elements into the bitshuffle-LZ4 HDF5 chunk format.
+// block_elems == 0 -> default.  Returns bytes written, or < 0 on error.
+int64_t blit_bshuf_compress_lz4(const void* in_v, void* out_v, size_t nelem,
+                                size_t elem_size, size_t block_elems) {
+  const uint8_t* in = (const uint8_t*)in_v;
+  uint8_t* out = (uint8_t*)out_v;
+  if (block_elems == 0) block_elems = blit_bshuf_default_block_size(elem_size);
+  if (block_elems % kBlockedMult) return -2;
+  const size_t block_bytes = block_elems * elem_size;
+
+  uint8_t* p = out;
+  store_be64(p, (uint64_t)nelem * elem_size); p += 8;
+  store_be32(p, (uint32_t)block_bytes); p += 4;
+
+  // Scratch for one shuffled block.
+  uint8_t* tmp = new uint8_t[block_bytes];
+  size_t done = 0;
+  while (done + block_elems <= nelem) {
+    shuffle_block(in + done * elem_size, tmp, block_elems, elem_size);
+    int c = LZ4_compress_default((const char*)tmp, (char*)(p + 4),
+                                 (int)block_bytes,
+                                 LZ4_compressBound((int)block_bytes));
+    if (c <= 0) { delete[] tmp; return -3; }
+    store_be32(p, (uint32_t)c);
+    p += 4 + c;
+    done += block_elems;
+  }
+  // Final partial block, rounded down to a multiple of 8 elements.
+  size_t rem = nelem - done;
+  size_t last = rem - rem % kBlockedMult;
+  if (last) {
+    size_t last_bytes = last * elem_size;
+    shuffle_block(in + done * elem_size, tmp, last, elem_size);
+    int c = LZ4_compress_default((const char*)tmp, (char*)(p + 4),
+                                 (int)last_bytes,
+                                 LZ4_compressBound((int)last_bytes));
+    if (c <= 0) { delete[] tmp; return -3; }
+    store_be32(p, (uint32_t)c);
+    p += 4 + c;
+    done += last;
+  }
+  delete[] tmp;
+  // Sub-8-element leftover: raw copy, no framing.
+  size_t left_bytes = (nelem - done) * elem_size;
+  if (left_bytes) {
+    std::memcpy(p, in + done * elem_size, left_bytes);
+    p += left_bytes;
+  }
+  return (int64_t)(p - out);
+}
+
+// Decompress a bitshuffle-LZ4 chunk.  out must hold nelem*elem_size bytes.
+// Returns bytes consumed from `in`, or < 0 on error.
+int64_t blit_bshuf_decompress_lz4(const void* in_v, size_t in_size,
+                                  void* out_v, size_t nelem,
+                                  size_t elem_size) {
+  const uint8_t* in = (const uint8_t*)in_v;
+  uint8_t* out = (uint8_t*)out_v;
+  if (in_size < 12) return -1;
+  const uint64_t total = load_be64(in);
+  if (total != (uint64_t)nelem * elem_size) return -4;
+  const size_t block_bytes = load_be32(in + 8);
+  if (block_bytes == 0 || block_bytes % (kBlockedMult * elem_size)) return -2;
+  const size_t block_elems = block_bytes / elem_size;
+  const uint8_t* p = in + 12;
+  const uint8_t* end = in + in_size;
+
+  uint8_t* tmp = new uint8_t[block_bytes];
+  size_t done = 0;
+  while (done < nelem - nelem % kBlockedMult) {
+    size_t this_elems = block_elems;
+    if (done + this_elems > nelem) this_elems = (nelem - done) - (nelem - done) % kBlockedMult;
+    if (this_elems == 0) break;
+    size_t this_bytes = this_elems * elem_size;
+    if (p + 4 > end) { delete[] tmp; return -1; }
+    uint32_t c = load_be32(p); p += 4;
+    if (p + c > end) { delete[] tmp; return -1; }
+    int d = LZ4_decompress_safe((const char*)p, (char*)tmp, (int)c,
+                                (int)this_bytes);
+    if (d != (int)this_bytes) { delete[] tmp; return -3; }
+    unshuffle_block(tmp, out + done * elem_size, this_elems, elem_size);
+    p += c;
+    done += this_elems;
+  }
+  delete[] tmp;
+  size_t left_bytes = (nelem - done) * elem_size;
+  if (left_bytes) {
+    if (p + left_bytes > end) return -1;
+    std::memcpy(out + done * elem_size, p, left_bytes);
+    p += left_bytes;
+  }
+  return (int64_t)(p - in);
+}
+
+}  // extern "C"

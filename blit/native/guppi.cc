@@ -1,0 +1,72 @@
+// Threaded GUPPI RAW block reader — the C++ rebuild of Blio.jl's native-side
+// role (SURVEY.md §2.3: "GUPPI RAW block reader ... for the GB/s host→device
+// feed").  Python's single-threaded read path caps well below NVMe/pagecache
+// bandwidth; this reader fans pread() calls across threads so a voltage
+// block lands in the destination buffer at storage speed.
+//
+// Exposed C ABI (ctypes-consumed by blit/io/native.py):
+//   blit_guppi_pread(path, offset, size, out, nthreads) -> 0 | errno-like <0
+
+#include <fcntl.h>
+#include <unistd.h>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// One worker: pread [off, off+len) into dst.
+int pread_range(int fd, uint8_t* dst, uint64_t off, uint64_t len) {
+  while (len > 0) {
+    ssize_t r = ::pread(fd, dst, len, (off_t)off);
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return -errno;
+    }
+    if (r == 0) return -EIO;  // unexpected EOF
+    dst += r;
+    off += (uint64_t)r;
+    len -= (uint64_t)r;
+  }
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+int blit_guppi_pread(const char* path, uint64_t offset, uint64_t size,
+                     void* out, int nthreads) {
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return -errno;
+  if (nthreads < 1) nthreads = 1;
+  // Don't spawn threads for small reads (syscall + join overhead).
+  const uint64_t kMinPerThread = 4ull << 20;
+  uint64_t want = (size + kMinPerThread - 1) / kMinPerThread;
+  if ((uint64_t)nthreads > want) nthreads = (int)want;
+  if (nthreads <= 1) {
+    int rc = pread_range(fd, (uint8_t*)out, offset, size);
+    ::close(fd);
+    return rc;
+  }
+  std::vector<std::thread> threads;
+  std::vector<int> rcs(nthreads, 0);
+  uint64_t chunk = size / nthreads;
+  for (int t = 0; t < nthreads; t++) {
+    uint64_t off = offset + (uint64_t)t * chunk;
+    uint64_t len = (t == nthreads - 1) ? size - (uint64_t)t * chunk : chunk;
+    uint8_t* dst = (uint8_t*)out + (uint64_t)t * chunk;
+    threads.emplace_back([fd, dst, off, len, t, &rcs] {
+      rcs[t] = pread_range(fd, dst, off, len);
+    });
+  }
+  for (auto& th : threads) th.join();
+  ::close(fd);
+  for (int rc : rcs)
+    if (rc) return rc;
+  return 0;
+}
+
+}  // extern "C"

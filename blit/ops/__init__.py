@@ -4,3 +4,12 @@ from blit.ops.fqav import fqav, fqav_range
 from blit.ops.stats import kurtosis
 
 __all__ = ["fqav", "fqav_range", "kurtosis"]
+
+
+def __getattr__(name):
+    # Lazy: channelize/dft/despike pull in JAX; keep `import blit.ops` light.
+    if name in ("channelize", "dft", "despike"):
+        import importlib
+
+        return importlib.import_module(f"blit.ops.{name}")
+    raise AttributeError(f"module 'blit.ops' has no attribute {name!r}")

@@ -39,10 +39,15 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from blit.ops import dft as dftmod
+
 STOKES_NIF = {"I": 1, "XX": 1, "YY": 1, "XXYY": 2, "full": 4, "IQUV": 4}
 
 # Largest FFT run as a single jnp.fft call; above this, four-step decompose.
 _DIRECT_FFT_MAX = 8192
+
+# Backends with no complex-dtype / FFT-HLO support: planar matmul DFT only.
+_MATMUL_ONLY_BACKENDS = ("tpu", "axon")
 
 
 def pfb_coeffs(ntap: int, nfft: int, window: str = "hamming") -> np.ndarray:
@@ -118,8 +123,39 @@ def _four_step_factors(n: int) -> Tuple[int, int]:
     return n1, n // n1
 
 
+def resolve_fft_method(method: str, n: int) -> str:
+    """Resolve ``"auto"`` to a concrete FFT strategy for the current backend.
+
+    On backends without complex-dtype support (this TPU generation — probed:
+    no FFT HLO, no complex matmul) the only path is the planar matmul DFT
+    (:mod:`blit.ops.dft`), which is also the MXU-preferred design.  On
+    CPU/GPU, native complex FFTs win: direct for small N, four-step above.
+    """
+    if method != "auto":
+        return method
+    if jax.default_backend() in _MATMUL_ONLY_BACKENDS:
+        return "matmul"
+    return "direct" if n <= _DIRECT_FFT_MAX else "four_step"
+
+
+def fft_planar(
+    fr: jax.Array,
+    fi: jax.Array,
+    *,
+    method: str = "auto",
+    precision=None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Planar (re, im) FFT along the last axis — the dispatch point between
+    the complex-dtype XLA paths and the TPU matmul-DFT path."""
+    method = resolve_fft_method(method, fr.shape[-1])
+    if method == "matmul":
+        return dftmod.dft(fr, fi, precision=precision)
+    z = fft(jax.lax.complex(fr, fi), method=method)
+    return jnp.real(z), jnp.imag(z)
+
+
 def fft(z: jax.Array, *, method: str = "auto") -> jax.Array:
-    """FFT along the last axis, TPU-shaped.
+    """Complex FFT along the last axis (CPU/GPU paths).
 
     ``method``:
       - ``"direct"``: one ``jnp.fft.fft`` call.
@@ -153,9 +189,11 @@ def fft(z: jax.Array, *, method: str = "auto") -> jax.Array:
     return jnp.swapaxes(b, -1, -2).reshape(z.shape)
 
 
-def detect_stokes(spec: jax.Array, stokes: str) -> jax.Array:
-    """Detect ``spec`` (..., npol, nframes, nfft) complex → power products
-    (..., nif, nframes, nfft) float32.
+def detect_stokes_planar(
+    sr: jax.Array, si: jax.Array, stokes: str
+) -> jax.Array:
+    """Detect planar spectra (re, im), each (..., npol, nframes, nfft) →
+    power products (..., nif, nframes, nfft) float32.
 
     Products (rawspec conventions, SURVEY.md §0):
       - ``"I"``:    |X|² + |Y|²                       (nif=1)
@@ -165,16 +203,16 @@ def detect_stokes(spec: jax.Array, stokes: str) -> jax.Array:
       - ``"IQUV"``: Stokes parameters                 (nif=4)
     Single-pol input only supports total power.
     """
-    npol = spec.shape[-3]
+    npol = sr.shape[-3]
     if npol == 1:
         if stokes not in ("I", "XX"):
             raise ValueError(f"stokes={stokes!r} needs 2 pols, got 1")
-        p = (spec.real**2 + spec.imag**2)[..., 0, :, :]
+        p = (sr**2 + si**2)[..., 0, :, :]
         return p[..., None, :, :]
-    xs = spec[..., 0, :, :]
-    ys = spec[..., 1, :, :]
-    xx = xs.real**2 + xs.imag**2
-    yy = ys.real**2 + ys.imag**2
+    xr, yr = sr[..., 0, :, :], sr[..., 1, :, :]
+    xi, yi = si[..., 0, :, :], si[..., 1, :, :]
+    xx = xr**2 + xi**2
+    yy = yr**2 + yi**2
     if stokes == "I":
         return (xx + yy)[..., None, :, :]
     if stokes == "XX":
@@ -183,14 +221,20 @@ def detect_stokes(spec: jax.Array, stokes: str) -> jax.Array:
         return yy[..., None, :, :]
     if stokes == "XXYY":
         return jnp.stack([xx, yy], axis=-3)
-    xy = xs * jnp.conj(ys)
+    # X·conj(Y):
+    xy_re = xr * yr + xi * yi
+    xy_im = xi * yr - xr * yi
     if stokes == "full":
-        return jnp.stack([xx, yy, xy.real, xy.imag], axis=-3)
+        return jnp.stack([xx, yy, xy_re, xy_im], axis=-3)
     if stokes == "IQUV":
-        return jnp.stack(
-            [xx + yy, xx - yy, 2 * xy.real, -2 * xy.imag], axis=-3
-        )
+        return jnp.stack([xx + yy, xx - yy, 2 * xy_re, -2 * xy_im], axis=-3)
     raise ValueError(f"unknown stokes {stokes!r}")
+
+
+def detect_stokes(spec: jax.Array, stokes: str) -> jax.Array:
+    """Complex-dtype convenience wrapper over :func:`detect_stokes_planar`
+    (CPU/GPU callers; the TPU path stays planar throughout)."""
+    return detect_stokes_planar(jnp.real(spec), jnp.imag(spec), stokes)
 
 
 def integrate(power: jax.Array, nint: int) -> jax.Array:
@@ -206,7 +250,10 @@ def integrate(power: jax.Array, nint: int) -> jax.Array:
 
 @functools.partial(
     jax.jit,
-    static_argnames=("nfft", "ntap", "nint", "stokes", "fft_method"),
+    static_argnames=(
+        "nfft", "ntap", "nint", "stokes", "fft_method", "precision",
+        "channel_block",
+    ),
 )
 def channelize(
     voltages: jax.Array,
@@ -217,6 +264,8 @@ def channelize(
     nint: int = 1,
     stokes: str = "I",
     fft_method: str = "auto",
+    precision: Optional[str] = None,
+    channel_block: int = 0,
 ) -> jax.Array:
     """The full single-chip reduction: int8 voltage block → filterbank slab.
 
@@ -228,7 +277,16 @@ def channelize(
       nfft: fine channels per coarse channel (the rawspec product size; 2**20
         for the hi-res product).
       nint: spectra integrated per output sample.
-      stokes: detection product (see :func:`detect_stokes`).
+      stokes: detection product (see :func:`detect_stokes_planar`).
+      fft_method: "auto" | "direct" | "four_step" | "matmul" (see
+        :func:`resolve_fft_method`; "auto" picks "matmul" on TPU).
+      precision: matmul precision for the "matmul" path — None (backend
+        default; bf16-grade multiplies on the MXU) or "highest" (full f32,
+        ~3x the MXU passes).
+      channel_block: if > 0 and < nchan, process coarse channels in groups
+        of this size via ``lax.map`` *inside* one device program — large
+        per-dispatch work (amortizing dispatch latency) at bounded peak HBM
+        (the hi-res 1M-point intermediates are what overflow otherwise).
 
     Returns:
       float32 ``(ntime_out, nif, nchan_coarse*nfft)`` in blit's canonical
@@ -237,15 +295,46 @@ def channelize(
       ``nfft//2`` (despike parity, blit/ops/despike.py).
     """
     nchan, _, npol, _ = voltages.shape
-    re, im = dequantize(voltages)  # (nchan, ntime, npol) each
-    re = jnp.moveaxis(re, -1, 1)  # (nchan, npol, ntime)
-    im = jnp.moveaxis(im, -1, 1)
-    fr = pfb_frontend(re, coeffs)  # (nchan, npol, nframes, nfft) real
-    fi = pfb_frontend(im, coeffs)
-    spec = fft(jax.lax.complex(fr, fi), method=fft_method)
-    spec = jnp.fft.fftshift(spec, axes=-1)
-    power = detect_stokes(spec, stokes)  # (nchan, nif, nframes, nfft)
-    power = integrate(power, nint)  # (nchan, nif, ntime_out, nfft)
+    if precision == "highest":
+        prec = jax.lax.Precision.HIGHEST
+    elif precision is None:
+        prec = None
+    else:
+        raise ValueError(f"precision must be None or 'highest', got {precision!r}")
+    if nfft % 2:
+        raise ValueError("channelize: nfft must be even")
+    # Fold the fftshift into the window via the shift theorem: multiplying
+    # the DFT input by (-1)^j rolls the spectrum by nfft/2, so the shifted
+    # coefficients make the FFT emit fftshifted order directly — two fewer
+    # full-array HBM passes.  (Frame sample index ≡ j mod 2 because nfft is
+    # even, so the sign pattern is tap-independent.)
+    sign = jnp.asarray(
+        np.where(np.arange(nfft) % 2 == 0, 1.0, -1.0).astype(np.float32)
+    )
+    shifted_coeffs = coeffs * sign[None, :]
+
+    def core(v):
+        re, im = dequantize(v)  # (cb, ntime, npol) each
+        re = jnp.moveaxis(re, -1, 1)  # (cb, npol, ntime)
+        im = jnp.moveaxis(im, -1, 1)
+        fr = pfb_frontend(re, shifted_coeffs)  # (cb, npol, nframes, nfft)
+        fi = pfb_frontend(im, shifted_coeffs)
+        sr, si = fft_planar(fr, fi, method=fft_method, precision=prec)
+        power = detect_stokes_planar(sr, si, stokes)  # (cb, nif, frames, nfft)
+        return integrate(power, nint)  # (cb, nif, ntime_out, nfft)
+
+    if channel_block and channel_block < nchan:
+        if nchan % channel_block:
+            raise ValueError(
+                f"channel_block={channel_block} does not divide nchan={nchan}"
+            )
+        groups = voltages.reshape(
+            (nchan // channel_block, channel_block) + voltages.shape[1:]
+        )
+        power = jax.lax.map(core, groups)  # (g, cb, nif, t, nfft)
+        power = power.reshape((nchan,) + power.shape[2:])
+    else:
+        power = core(voltages)
     # → (ntime_out, nif, nchan*nfft), channel fastest.
     out = jnp.transpose(power, (2, 1, 0, 3))
     return out.reshape(out.shape[0], out.shape[1], nchan * nfft)

@@ -1,0 +1,175 @@
+"""Planar (real/imag) DFT on the MXU: FFT as matmuls.
+
+The TPU-native FFT path.  Two facts drive this design (probed on hardware,
+see bench.py):
+
+1. This TPU backend implements **no complex-dtype ops** (no FFT HLO, no
+   complex matmul, not even complex device_put) — the compute path must be
+   real-valued end to end.
+2. The MXU wants big batched matmuls.  A DFT *is* a matmul (``y = W x``), and
+   the four-step factorization N = N1·N2 turns an arbitrarily large FFT into
+   two batched ≤4K-point DFT matmuls plus one elementwise twiddle — for the
+   1M-point hi-res product that is two 1024×1024 matrices applied to large
+   batches: peak MXU shape (SURVEY.md §7 "hard parts", pallas_guide.md MXU
+   notes).
+
+"Planar" complex convention used across blit's TPU path: a complex array is
+a ``(re, im)`` pair of equal-shape real arrays.  4 real matmuls implement one
+complex matmul; XLA fuses the adds.
+
+All matrices/twiddles are precomputed NumPy constants — they are jit-time
+constants, transferred to HBM once and reused every step.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+# Largest DFT applied as a single matmul; larger sizes four-step-decompose.
+# 4096² f32 matrices are 64 MB each — HBM-comfortable, VMEM-tileable.
+DIRECT_DFT_MAX = 4096
+
+Planar = Tuple[jax.Array, jax.Array]
+
+
+@functools.lru_cache(maxsize=32)
+def dft_matrices(n: int, dtype: str = "float32") -> Tuple[np.ndarray, np.ndarray]:
+    """(Wr, Wi): real and imaginary parts of the n-point DFT matrix
+    ``W[k, j] = exp(-2πi k j / n)`` (symmetric, so it applies to either
+    side of a matmul without transposition)."""
+    k = np.arange(n).reshape(n, 1).astype(np.float64)
+    j = np.arange(n).reshape(1, n).astype(np.float64)
+    ang = -2.0 * np.pi * ((k * j) % n) / n
+    return np.cos(ang).astype(dtype), np.sin(ang).astype(dtype)
+
+
+@functools.lru_cache(maxsize=32)
+def twiddles(n1: int, n2: int, dtype: str = "float32") -> Tuple[np.ndarray, np.ndarray]:
+    """(Tr, Ti): four-step twiddle factors ``exp(-2πi k1 j2 / (n1 n2))``
+    shaped (n1, n2) — k1 indexes stage-1 output rows, j2 stage-2 columns."""
+    n = n1 * n2
+    k1 = np.arange(n1).reshape(n1, 1).astype(np.float64)
+    j2 = np.arange(n2).reshape(1, n2).astype(np.float64)
+    ang = -2.0 * np.pi * ((k1 * j2) % n) / n
+    return np.cos(ang).astype(dtype), np.sin(ang).astype(dtype)
+
+
+def default_factors(n: int) -> Tuple[int, ...]:
+    """Factorization policy for the multi-level decomposition.
+
+    The DFT-matmul cost is ``N · Σ factors`` complex MACs, so small factors
+    win FLOPs — but the MXU is a 128×128 systolic array, so factors below
+    128 waste it.  Policy: peel factors of 128 while the remainder stays
+    >= 128, yielding e.g. 2^20 → (128, 128, 64) (sum 320, 6.4× fewer FLOPs
+    than the square 1024×1024 split).  Non-power-of-two sizes fall back to
+    as-square-as-possible two-factor splits.
+    """
+    if n <= DIRECT_DFT_MAX:
+        return (n,)
+    if n & (n - 1) == 0:
+        factors = []
+        while n > DIRECT_DFT_MAX:
+            f = min(128, n)
+            factors.append(f)
+            n //= f
+        factors.append(n)
+        return tuple(factors)
+    n1 = int(math.isqrt(n))
+    while n % n1:
+        n1 -= 1
+    if n1 == 1 or max(n1, n // n1) > DIRECT_DFT_MAX:
+        raise NotImplementedError(
+            f"dft: no supported factorization for n={n}"
+        )
+    return (n1, n // n1)
+
+
+def _cmatmul_last(
+    xr: jax.Array, xi: jax.Array, wr: jax.Array, wi: jax.Array, precision
+) -> Planar:
+    """Complex DFT along the LAST axis via 4 real matmuls:
+    ``y[..., k] = Σ_j x[..., j]·W[k, j]`` — with symmetric W this is
+    ``x @ W``."""
+    rr = jnp.matmul(xr, wr, precision=precision)
+    ri = jnp.matmul(xr, wi, precision=precision)
+    ir = jnp.matmul(xi, wr, precision=precision)
+    ii = jnp.matmul(xi, wi, precision=precision)
+    return rr - ii, ri + ir
+
+
+def dft(
+    xr: jax.Array,
+    xi: jax.Array,
+    *,
+    precision=None,
+    dtype: str = "float32",
+    factors: Optional[Tuple[int, ...]] = None,
+) -> Planar:
+    """Planar DFT along the last axis.
+
+    Sizes <= DIRECT_DFT_MAX use one matmul; larger sizes recurse on the
+    Cooley-Tukey split n = n1 · rest — an n1-point DFT matmul down the
+    columns, a twiddle multiply, and a recursive DFT along the rows.  With
+    :func:`default_factors` the 1M-point case runs as three matmul stages
+    (128, 128, 64).  Matches ``np.fft.fft`` (golden-tested).
+
+    ``precision``: a ``jax.lax.Precision`` for the matmuls — ``HIGHEST``
+    forces full-f32 MXU passes; None uses the backend default (bf16-grade
+    multiplies on TPU, exact on CPU).
+    ``factors``: override the factorization (each factor <= DIRECT_DFT_MAX,
+    product == n); None → :func:`default_factors`.
+    """
+    n = xr.shape[-1]
+    if factors is None:
+        factors = default_factors(n)
+    if int(np.prod(factors)) != n:
+        raise ValueError(f"dft: factors {factors} do not multiply to {n}")
+    return _dft_rec(xr, xi, factors, precision, dtype)
+
+
+def _dft_rec(
+    xr: jax.Array, xi: jax.Array, factors: Tuple[int, ...], precision, dtype
+) -> Planar:
+    n = xr.shape[-1]
+    if len(factors) == 1:
+        if n > DIRECT_DFT_MAX:
+            raise NotImplementedError(f"dft: single factor {n} too large")
+        wr, wi = dft_matrices(n, dtype)
+        return _cmatmul_last(xr, xi, jnp.asarray(wr), jnp.asarray(wi), precision)
+    n1 = factors[0]
+    n2 = n // n1
+    batch = xr.shape[:-1]
+    # x[j] with j = n2*j1 + j2 → rows j1, cols j2.
+    xr_ = xr.reshape(batch + (n1, n2))
+    xi_ = xi.reshape(batch + (n1, n2))
+    # Stage 1: n1-point DFTs down the columns.  Contract axis -2 with the
+    # symmetric W1: y[..., k1, j2] = Σ_j1 W1[k1, j1] x[..., j1, j2].
+    w1r, w1i = (jnp.asarray(a) for a in dft_matrices(n1, dtype))
+    ar = jnp.einsum("kj,...jm->...km", w1r, xr_, precision=precision)
+    ai = jnp.einsum("kj,...jm->...km", w1i, xr_, precision=precision)
+    br = jnp.einsum("kj,...jm->...km", w1r, xi_, precision=precision)
+    bi = jnp.einsum("kj,...jm->...km", w1i, xi_, precision=precision)
+    sr, si = ar - bi, ai + br
+    # Twiddle (elementwise, fuses into the surrounding ops).
+    tr, ti = (jnp.asarray(a) for a in twiddles(n1, n2, dtype))
+    ur = sr * tr - si * ti
+    ui = sr * ti + si * tr
+    # Recurse: n2-point DFTs along the rows (last axis).
+    vr, vi = _dft_rec(ur, ui, factors[1:], precision, dtype)
+    # Output index k = k1 + n1*k2: transpose (k1, k2) → (k2, k1) then flatten.
+    vr = jnp.swapaxes(vr, -1, -2).reshape(batch + (n,))
+    vi = jnp.swapaxes(vi, -1, -2).reshape(batch + (n,))
+    return vr, vi
+
+
+def dft_np(xr: np.ndarray, xi: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """NumPy golden reference (tests)."""
+    z = np.fft.fft(xr + 1j * xi)
+    return z.real, z.imag

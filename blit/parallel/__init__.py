@@ -15,3 +15,12 @@ ssh workers (SURVEY.md §2.4).  blit splits that into:
 from blit.parallel.pool import WorkerError, WorkerPool, setup_workers, current_pool
 
 __all__ = ["WorkerError", "WorkerPool", "setup_workers", "current_pool"]
+
+
+def __getattr__(name):
+    # Lazy: mesh/beamform/correlator pull in JAX; pool-only users stay light.
+    if name in ("mesh", "beamform", "correlator"):
+        import importlib
+
+        return importlib.import_module(f"blit.parallel.{name}")
+    raise AttributeError(f"module 'blit.parallel' has no attribute {name!r}")

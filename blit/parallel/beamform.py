@@ -1,0 +1,135 @@
+"""Coherent multibeam (tied-array) beamforming over the device mesh.
+
+BASELINE.json config 4: "per-bank phase-rotate + psum across 8 chips →
+64-beam tied-array filterbank".  The structural analog in SURVEY.md §2.4:
+coherent beamforming's cross-chip ``psum`` is the tensor-parallel reduction
+of this framework.
+
+Data model: the *antenna* axis is sharded across a mesh axis (default
+``bank``) — each chip holds a contiguous block of antennas' voltages for the
+whole (local) frequency range.  Per beam, each chip phase-rotates its
+antennas by the geometric-delay phasor and partially sums them (one MXU
+matmul over the antenna axis); the ``psum`` over the mesh axis completes the
+tied-array sum.  Detection + integration then reuse the single-chip kernels.
+
+The reference has no beamforming (it reads post-rawspec products) — this is
+the capability extension BASELINE.json prescribes, built so the per-chip
+math is plain jnp and the collective is a single explicit ``psum``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from blit.ops.channelize import integrate
+
+ANT_AXIS_DEFAULT = "bank"
+
+
+def delay_weights(
+    delays_s: jax.Array, freqs_hz: jax.Array, amplitudes: Optional[jax.Array] = None
+) -> jax.Array:
+    """Per-(beam, antenna, channel) phasors from geometric delays.
+
+    ``delays_s``: (nbeam, nant) seconds; ``freqs_hz``: (nchan,) sky
+    frequencies of the coarse channels.  Returns complex64 weights
+    ``exp(-2πi f τ)`` shaped (nbeam, nant, nchan), optionally scaled by
+    per-antenna ``amplitudes`` (nbeam, nant) or (nant,).
+    """
+    phase = -2.0 * jnp.pi * delays_s[..., None] * freqs_hz[None, None, :]
+    w = jnp.exp(1j * phase.astype(jnp.float32))
+    if amplitudes is not None:
+        amp = jnp.asarray(amplitudes)
+        if amp.ndim == 1:
+            amp = amp[None, :]
+        w = w * amp[..., None]
+    return w.astype(jnp.complex64)
+
+
+def _local_beams(v: jax.Array, w: jax.Array) -> jax.Array:
+    """Partial tied-array sum over this chip's antennas.
+
+    ``v``: (nant_local, nchan, ntime, npol) complex voltages;
+    ``w``: (nbeam, nant_local, nchan) weights.
+    Returns (nbeam, nchan, ntime, npol) partial beam voltages.  The
+    contraction over antennas is a batched matmul (MXU work).
+    """
+    return jnp.einsum("bac,actp->bctp", w, v)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("mesh", "axis", "nint", "detect")
+)
+def beamform(
+    voltages: jax.Array,
+    weights: jax.Array,
+    *,
+    mesh: Mesh,
+    axis: str = ANT_AXIS_DEFAULT,
+    nint: int = 1,
+    detect: bool = True,
+) -> jax.Array:
+    """Form tied-array beams across the mesh.
+
+    Args:
+      voltages: complex64 ``(nant, nchan, ntime, npol)``, antenna axis
+        sharded over ``axis`` (see :func:`antenna_sharding`).
+      weights: complex64 ``(nbeam, nant, nchan)`` phasors (antenna axis
+        sharded identically).
+      detect: True → per-beam total power ``(nbeam, nchan, ntime_out, npol)``
+        float32 integrated by ``nint``; False → raw beam voltages
+        ``(nbeam, nchan, ntime, npol)`` complex64 (for downstream fine
+        channelization).
+
+    The only communication is one ``psum`` over ``axis`` — partial antenna
+    sums travel, never raw voltages.
+    """
+    def step(v, w):
+        beams = _local_beams(v, w)
+        beams = jax.lax.psum(beams, axis)
+        if detect:
+            p = (beams.real**2 + beams.imag**2).astype(jnp.float32)
+            # (nbeam, nchan, ntime, npol): integrate() groups along axis -2,
+            # which is time here.
+            return integrate(p, nint)
+        return beams
+
+    return jax.shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(P(axis), P(None, axis)),
+        out_specs=P(),
+        check_vma=False,  # psum output is axis-invariant
+    )(voltages, weights)
+
+
+def antenna_sharding(mesh: Mesh, axis: str = ANT_AXIS_DEFAULT) -> NamedSharding:
+    """Sharding for (nant, nchan, ntime, npol) voltages: antennas over
+    ``axis``, everything else replicated."""
+    return NamedSharding(mesh, P(axis))
+
+
+def weight_sharding(mesh: Mesh, axis: str = ANT_AXIS_DEFAULT) -> NamedSharding:
+    """Sharding for (nbeam, nant, nchan) weights, matching
+    :func:`antenna_sharding`."""
+    return NamedSharding(mesh, P(None, axis))
+
+
+def beamform_np(voltages: np.ndarray, weights: np.ndarray, nint: int = 1,
+                detect: bool = True) -> np.ndarray:
+    """NumPy golden reference for :func:`beamform` (tests)."""
+    beams = np.einsum("bac,actp->bctp", weights, voltages)
+    if not detect:
+        return beams
+    p = (beams.real**2 + beams.imag**2).astype(np.float32)
+    if nint > 1:
+        b, c, t, q = p.shape
+        p = p.reshape(b, c, t // nint, nint, q).sum(axis=3)
+    return p

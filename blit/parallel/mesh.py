@@ -1,0 +1,165 @@
+"""The TPU data plane: the BL@GBT ``(band, bank)`` topology as a device mesh.
+
+SURVEY.md §2.4/§5: the reference's only parallelism is frequency-domain
+sharding — 8 banks each own a contiguous 187.5 MHz slice of a 1500 MHz band,
+and the sole cross-node reduction (band stitching) runs as a main-process
+``vcat`` in the commented-out ``loadscan`` (src/gbt.jl:103).  Here the
+topology is a ``jax.sharding.Mesh`` with axes ``('band', 'bank')``, each chip
+plays one ``BLP<band><bank>`` player, the frequency axis is sharded over
+``bank``, and the stitch is an ``all_gather`` over ICI — no host
+materialization anywhere (BASELINE.json config 3).
+
+Everything is built on ``shard_map`` so the collectives are explicit and the
+per-chip body is exactly the single-chip reduction from
+:mod:`blit.ops.channelize` — one code path from 1 chip to a 64-chip pod.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from blit.ops.channelize import channelize
+from blit.ops.despike import despike
+
+BAND_AXIS = "band"
+BANK_AXIS = "bank"
+
+
+def make_mesh(
+    nband: int = 1, nbank: int = 8, devices: Optional[list] = None
+) -> Mesh:
+    """A ``(band, bank)`` mesh over the first ``nband*nbank`` devices.
+
+    The bank axis should ride ICI (it carries the stitch/beamform
+    collectives); keeping it minor in the device order does that on TPU
+    slices, mirroring how the racks' 8 banks share a 1500 MHz IF
+    (README.md:17-24).
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = nband * nbank
+    if len(devices) < n:
+        raise ValueError(f"need {n} devices for a {nband}x{nbank} mesh, "
+                         f"have {len(devices)}")
+    dev = np.asarray(devices[:n]).reshape(nband, nbank)
+    return Mesh(dev, (BAND_AXIS, BANK_AXIS))
+
+
+def voltage_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for a global voltage array ``(nband, nbank, nchan, ntime,
+    npol, 2)``: one (band, bank) block per chip."""
+    return NamedSharding(mesh, P(BAND_AXIS, BANK_AXIS))
+
+
+def filterbank_sharding(mesh: Mesh, stitched: bool) -> NamedSharding:
+    """Sharding of the reduced product ``(nband, ntime, nif, nchans)``:
+    channel axis sharded over ``bank`` (unstitched) or replicated across the
+    bank axis (stitched)."""
+    if stitched:
+        return NamedSharding(mesh, P(BAND_AXIS, None, None, None))
+    return NamedSharding(mesh, P(BAND_AXIS, None, None, BANK_AXIS))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "mesh", "nfft", "ntap", "nint", "stokes", "fft_method", "stitch",
+        "despike_nfpc",
+    ),
+)
+def band_reduce(
+    voltages: jax.Array,
+    coeffs: jax.Array,
+    *,
+    mesh: Mesh,
+    nfft: int,
+    ntap: int = 4,
+    nint: int = 1,
+    stokes: str = "I",
+    fft_method: str = "auto",
+    stitch: bool = True,
+    despike_nfpc: int = 0,
+) -> jax.Array:
+    """The full multi-chip reduction step: every chip channelizes its own
+    bank's voltage block, then the 8 banks of each band stitch their fine
+    spectra into a contiguous band over ICI.
+
+    Args:
+      voltages: int8 ``(nband, nbank, nchan, ntime, npol, 2)``, sharded with
+        :func:`voltage_sharding` (one leading block per chip).
+      stitch: gather the bank-sharded channel axis into a contiguous band on
+        every chip of the band row (``all_gather`` over ``bank`` — the ICI
+        rebuild of the reference's main-process ``vcat``, src/gbt.jl:103).
+        When False the product stays frequency-sharded (the SP-like layout)
+        and no collective runs at all.
+      despike_nfpc: if >= 2, repair each coarse channel's DC fine channel
+        post-stitch (src/gbt.jl:101-111 semantics, vectorized).
+
+    Returns:
+      float32 ``(nband, ntime_out, nif, nchans)`` where ``nchans`` is the
+      full band (stitched) or the global concatenation of per-bank channels
+      (unstitched, sharded over ``bank``).
+    """
+    in_specs = (P(BAND_AXIS, BANK_AXIS), P())
+    out_specs = (
+        P(BAND_AXIS, None, None, None)
+        if stitch
+        else P(BAND_AXIS, None, None, BANK_AXIS)
+    )
+
+    def step(v, h):
+        # v: (1, 1, nchan, ntime, npol, 2) — this chip's block.
+        out = channelize(
+            v[0, 0], h, nfft=nfft, ntap=ntap, nint=nint, stokes=stokes,
+            fft_method=fft_method,
+        )  # (t, nif, nchan*nfft)
+        if stitch:
+            out = jax.lax.all_gather(out, BANK_AXIS, axis=2, tiled=True)
+            if despike_nfpc >= 2:
+                out = despike(out, despike_nfpc)
+        elif despike_nfpc >= 2:
+            # Coarse channels never straddle banks, so the per-bank despike
+            # is exact in the sharded layout too.
+            out = despike(out, despike_nfpc)
+        return out[None]  # leading band axis block
+
+    # check_vma=False when stitching: the varying-mesh-axes analysis cannot
+    # statically see that all_gather's output is bank-invariant.
+    return jax.shard_map(
+        step, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_vma=not stitch,
+    )(voltages, coeffs)
+
+
+def stitch_bands(x: jax.Array, mesh: Mesh) -> jax.Array:
+    """Standalone stitch: gather a bank-sharded filterbank ``(nband, t, nif,
+    nchans_sharded)`` into a contiguous band, replicated across each band's
+    banks.  Equivalent to ``band_reduce(..., stitch=True)``'s epilogue; kept
+    separate so host-read products (e.g. FBH5 slabs loaded via
+    :mod:`blit.gbt`) can be stitched on-device too."""
+
+    def gather(blk):
+        return jax.lax.all_gather(blk, BANK_AXIS, axis=3, tiled=True)
+
+    return jax.shard_map(
+        gather,
+        mesh=mesh,
+        in_specs=P(BAND_AXIS, None, None, BANK_AXIS),
+        out_specs=P(BAND_AXIS, None, None, None),
+        check_vma=False,  # all_gather output is bank-invariant
+    )(x)
+
+
+def shard_voltages(
+    voltages: np.ndarray, mesh: Mesh
+) -> jax.Array:
+    """Place a host ``(nband, nbank, ...)`` voltage array onto the mesh with
+    one block per chip (the host→device feed for tests and the dry run)."""
+    return jax.device_put(voltages, voltage_sharding(mesh))
