@@ -1,0 +1,98 @@
+"""blit benchmark — the driver-tracked metric (BASELINE.json).
+
+Measures sustained single-chip GUPPI RAW → hi-res filterbank reduction:
+int8 dual-pol complex voltages through dequant → 4-tap PFB → 1M-point
+matmul-DFT channelization → Stokes-I detect (blit.ops.channelize, the
+rawspec-equivalent hi-res "0000" product).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": GB/s/chip of net RAW input, "unit": "GB/s",
+   "vs_baseline": real-time factor vs one bank's 0.75 GB/s recording rate}
+
+The north-star target is >= 4x real-time for a full bank (BASELINE.json:
+>= 3 GB/s/chip).  "Net" input counts each voltage sample once (the PFB
+overlap re-processing is not credited).
+
+Methodology: data device-resident, K dispatches enqueued back-to-back, one
+final sync — steady-state streaming with dispatch latency amortized, matching
+how blit.pipeline overlaps host IO with device work.  On non-TPU backends
+(dev machines) a small config keeps runtime sane; the reported config is in
+the JSON's "config" field either way.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+# Per-bank recording rate: 187.5 Msamp/s x 2 pol x 2 bytes (SURVEY.md §6).
+REALTIME_BANK_GBPS = 0.750
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from blit.ops.channelize import channelize, pfb_coeffs
+
+    backend = jax.default_backend()
+    on_tpu = backend in ("tpu", "axon")
+    if on_tpu:
+        # Hi-res product, sized to HBM: 32 coarse channels x 5 frames of
+        # 2^20-point channelization per dispatch (671 MB net per call;
+        # measured 4.4 GB/s = 5.8x real-time on a v5e chip).
+        nfft, ntap, nint, nchan, frames, cb, K = 1 << 20, 4, 1, 32, 5, 0, 8
+    else:
+        nfft, ntap, nint, nchan, frames, cb, K = 1 << 14, 4, 1, 4, 4, 0, 4
+
+    ntime = (ntap - 1 + frames) * nfft
+    rng = np.random.default_rng(0)
+    v = rng.integers(-40, 40, size=(nchan, ntime, 2, 2), dtype=np.int8)
+    coeffs = jnp.asarray(pfb_coeffs(ntap, nfft))
+    vj = jax.block_until_ready(jnp.asarray(v))
+
+    def step(x):
+        out = channelize(
+            x, coeffs, nfft=nfft, ntap=ntap, nint=nint, stokes="I",
+            channel_block=cb,
+        )
+        # Tiny on-device reduction: forces execution while keeping the
+        # sync payload scalar (the tunnel's host readback is not the DUT).
+        return jnp.sum(out)
+
+    # Warmup / compile.
+    float(step(vj))
+
+    t0 = time.perf_counter()
+    acc = [step(vj) for _ in range(K)]
+    total = sum(float(a) for a in acc)
+    elapsed = time.perf_counter() - t0
+
+    net_bytes_per_call = frames * nfft * nchan * 2 * 2  # int8 re/im, 2 pol
+    gbps = net_bytes_per_call * K / elapsed / 1e9
+    result = {
+        "metric": "guppi_raw_to_hires_filterbank_GBps_per_chip",
+        "value": round(gbps, 3),
+        "unit": "GB/s",
+        "vs_baseline": round(gbps / REALTIME_BANK_GBPS, 2),
+        "config": {
+            "backend": backend,
+            "nfft": nfft,
+            "ntap": ntap,
+            "nint": nint,
+            "nchan": nchan,
+            "frames_per_call": frames,
+            "channel_block": cb,
+            "calls": K,
+            "stokes": "I",
+            "checksum": total,
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

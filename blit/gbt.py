@@ -195,3 +195,33 @@ def load_scan(
                 log.warning("band %d: skipping despike (nfpc=%s)", band, nfpc)
         out[band] = (hdr, stitched)
     return out
+
+
+def reduce_raw(
+    worker_ids: Sequence[int],
+    raw_paths: Sequence[str],
+    out_paths: Optional[Sequence[str]] = None,
+    *,
+    pool: Optional[WorkerPool] = None,
+    on_error: str = "raise",
+    **reducer_kw,
+) -> List:
+    """Fan GUPPI RAW → filterbank reduction out over the workers that own
+    the files, one (worker, raw file) pair at a time — the distributed
+    rawspec replacement (capability extension over the reference, which
+    only reads already-reduced products; BASELINE.json configs 1-2).
+
+    ``reducer_kw`` passes through to :func:`blit.workers.reduce_raw`
+    (``product=`` preset or ``nfft``/``nint``/``stokes``).
+    """
+    if len(worker_ids) != len(raw_paths):
+        raise ValueError("worker_ids and raw_paths must have the same size")
+    if out_paths is not None and len(out_paths) != len(raw_paths):
+        raise ValueError("out_paths must match raw_paths")
+    p = _pool(pool)
+    args = [
+        (rp,) if out_paths is None else (rp, op)
+        for rp, op in zip(raw_paths, out_paths or raw_paths)
+    ]
+    return p.run_on(worker_ids, wf.reduce_raw, args, kwargs=reducer_kw,
+                    on_error=on_error)

@@ -116,3 +116,37 @@ def get_freq_axis(header: Dict, fqav_by: int = 1) -> Tuple[float, float, int]:
     optional frequency averaging — the range arithmetic the reference
     exposes as ``fqav(::AbstractRange, n)`` (src/gbtworkerfunctions.jl:27-33)."""
     return fqav_range(header["fch1"], header["foff"], header["nchans"], fqav_by)
+
+
+def reduce_raw(
+    raw_path: str,
+    out_path: Optional[str] = None,
+    product: Optional[str] = None,
+    nfft: int = 1024,
+    nint: int = 1,
+    stokes: str = "I",
+    **reducer_kw,
+):
+    """Reduce a GUPPI RAW file to a filterbank product on this worker — the
+    rawspec-equivalent stage the reference assumes already ran on each node
+    (SURVEY.md §0 "File products").
+
+    ``product`` selects a standard rawspec preset ("0000"/"0001"/"0002",
+    blit/pipeline.py); otherwise ``nfft``/``nint``/``stokes`` configure the
+    reduction directly.  With ``out_path`` the product is written
+    (``.fil``/``.h5`` by extension) and the output header returned; without
+    it, ``(header, data)`` come back over the wire (small products only).
+    """
+    from blit.pipeline import RawReducer, reducer_for_product
+
+    if product is not None:
+        if nfft != 1024 or nint != 1:
+            raise ValueError(
+                "reduce_raw: pass either product= or explicit nfft/nint, not both"
+            )
+        red = reducer_for_product(product, stokes=stokes, **reducer_kw)
+    else:
+        red = RawReducer(nfft=nfft, nint=nint, stokes=stokes, **reducer_kw)
+    if out_path is not None:
+        return red.reduce_to_file(raw_path, out_path)
+    return red.reduce(raw_path)
