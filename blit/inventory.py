@@ -11,6 +11,7 @@ files filtered by ``file_re``; each hit is parsed with
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import socket
@@ -132,3 +133,39 @@ def to_dataframe(inventories: Iterable[Iterable[InventoryRecord]]):
 
     flat = [rec for inv in inventories for rec in inv]
     return pd.DataFrame(flat, columns=InventoryRecord._fields)
+
+
+def save_inventories(path: str, inventories) -> int:
+    """Persist per-worker inventories as JSON-lines (the reference's
+    "state" is a saved pid vector + inventory DataFrame, README.md:62-64,
+    100-101 — this is the durable half).  Each line is one record plus its
+    worker-list index, so :func:`load_inventories` restores the ragged
+    per-worker shape exactly.  Returns the record count."""
+    n = 0
+    with open(path, "w") as f:
+        for w, inv in enumerate(inventories):
+            wrote_any = False
+            for rec in inv:
+                row = rec._asdict()
+                row["_w"] = w
+                f.write(json.dumps(row) + "\n")
+                n += 1
+                wrote_any = True
+            if not wrote_any:
+                f.write(json.dumps({"_w": w, "_empty": True}) + "\n")
+    return n
+
+
+def load_inventories(path: str) -> List[List[InventoryRecord]]:
+    """Restore what :func:`save_inventories` wrote (ragged shape included)."""
+    out: List[List[InventoryRecord]] = []
+    with open(path) as f:
+        for line in f:
+            row = json.loads(line)
+            w = row.pop("_w")
+            while len(out) <= w:
+                out.append([])
+            if row.pop("_empty", False):
+                continue
+            out[w].append(InventoryRecord(**row))
+    return out

@@ -1,0 +1,112 @@
+"""Observability: per-stage timing, throughput counters, profiler traces,
+structured per-host logging.
+
+SURVEY.md §5: the reference's only observability is three ``@warn`` sites
+plus the host name stamped into inventory rows.  blit keeps the host/worker
+stamping and adds what a GB/s-class pipeline needs: a stage-timing registry
+(cheap, always on), optional JAX profiler traces (TensorBoard/Perfetto),
+and log records that carry host/worker context.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import socket
+import time
+from collections import defaultdict
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, Optional
+
+
+@dataclass
+class StageStats:
+    """Accumulated wall time + optional byte counts for one pipeline stage."""
+
+    calls: int = 0
+    seconds: float = 0.0
+    bytes: int = 0
+
+    @property
+    def gbps(self) -> float:
+        return self.bytes / self.seconds / 1e9 if self.seconds else 0.0
+
+
+@dataclass
+class Timeline:
+    """A registry of named stage timings (one per pipeline/driver)."""
+
+    stages: Dict[str, StageStats] = field(default_factory=lambda: defaultdict(StageStats))
+
+    @contextlib.contextmanager
+    def stage(self, name: str, nbytes: int = 0) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            s = self.stages[name]
+            s.calls += 1
+            s.seconds += time.perf_counter() - t0
+            s.bytes += nbytes
+
+    def report(self) -> Dict[str, Dict]:
+        return {
+            k: {"calls": v.calls, "seconds": round(v.seconds, 6),
+                "bytes": v.bytes, "gbps": round(v.gbps, 3)}
+            for k, v in sorted(self.stages.items())
+        }
+
+    def log(self, logger: Optional[logging.Logger] = None) -> None:
+        (logger or logging.getLogger("blit.timeline")).info(
+            "timeline %s", json.dumps(self.report())
+        )
+
+
+@contextlib.contextmanager
+def profile_trace(logdir: Optional[str]) -> Iterator[None]:
+    """JAX profiler trace around a region (TensorBoard/Perfetto readable).
+    ``logdir=None`` is a no-op, so call sites need no conditionals."""
+    if logdir is None:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(logdir):
+        yield
+
+
+class HostContextFilter(logging.Filter):
+    """Injects ``host`` and ``worker`` fields into every record so the
+    fan-out logs stay attributable (the reference stamps host into every
+    inventory row for the same reason, src/gbtworkerfunctions.jl:74)."""
+
+    def __init__(self, worker: int = 0):
+        super().__init__()
+        self.host = socket.gethostname()
+        self.worker = worker
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        record.host = self.host
+        record.worker = self.worker
+        return True
+
+
+def configure_logging(level: int = logging.INFO, worker: int = 0) -> None:
+    """Structured stderr logging with host/worker context for every blit
+    logger.  Idempotent: re-calling replaces the previous blit handler (a
+    worker re-configuring with its id must not duplicate output)."""
+    root = logging.getLogger("blit")
+    for h in list(root.handlers):
+        if getattr(h, "_blit_handler", False):
+            root.removeHandler(h)
+    handler = logging.StreamHandler()
+    handler._blit_handler = True
+    handler.addFilter(HostContextFilter(worker))
+    handler.setFormatter(
+        logging.Formatter(
+            "%(asctime)s %(levelname)s %(host)s/w%(worker)d %(name)s: %(message)s"
+        )
+    )
+    root.setLevel(level)
+    root.addHandler(handler)

@@ -1,0 +1,86 @@
+"""Multi-host bring-up: the TPU-pod analog of ``GBT.setupworkers``.
+
+SURVEY.md §5 "Distributed communication backend": the reference's control
+plane is ``Distributed.addprocs`` over ssh (src/gbt.jl:28-34).  On TPU the
+control plane is the JAX distributed runtime — one Python process per host,
+all chips visible as one global device list — and the data plane is XLA
+collectives over ICI/DCN.  This module wraps the bring-up and maps the
+global device list back onto `(band, bank)` players so each host knows which
+banks' files it must feed.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional, Tuple
+
+log = logging.getLogger("blit.multihost")
+
+_initialized = False
+
+
+def init_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    **kw,
+) -> bool:
+    """Initialize the JAX distributed runtime (idempotent).
+
+    With no arguments, relies on environment auto-detection (TPU pod
+    metadata / cluster env vars), which is also correct for single-process
+    runs — ``jax.distributed.initialize`` is then a no-op.  Returns True if
+    a multi-process runtime is active afterwards.
+    """
+    global _initialized
+    import jax
+
+    if not _initialized:
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+                **kw,
+            )
+        except RuntimeError as e:
+            # jax 0.9 raises "should only be called once" on re-init and
+            # "must be called before any JAX calls" once a backend exists —
+            # both mean the process is already past bring-up.
+            msg = str(e).lower()
+            if "once" not in msg and "before any jax calls" not in msg:
+                raise
+        except ValueError as e:
+            # No cluster auto-detection and no explicit coordinator: a plain
+            # single-process run ("coordinator_address should be defined").
+            if coordinator_address is not None:
+                raise
+            log.info("no cluster detected (%s); single-process mode", e)
+        _initialized = True
+    active = jax.process_count() > 1
+    log.info(
+        "distributed runtime: %d process(es), %d device(s), this is process %d",
+        jax.process_count(), jax.device_count(), jax.process_index(),
+    )
+    return active
+
+
+def player_map(mesh) -> Dict[Tuple[int, int], "object"]:
+    """{(band, bank): device} for a ``(band, bank)`` mesh — which chip plays
+    which ``BLP<band><bank>`` (README.md:21-23 naming)."""
+    out = {}
+    nband, nbank = mesh.devices.shape
+    for b in range(nband):
+        for k in range(nbank):
+            out[(b, k)] = mesh.devices[b, k]
+    return out
+
+
+def local_players(mesh) -> List[Tuple[int, int]]:
+    """The (band, bank) players whose chips belong to *this* process — the
+    banks whose files this host must feed (addressable shards of the global
+    voltage array)."""
+    import jax
+
+    mine = {d.id for d in jax.local_devices()}
+    return [pb for pb, dev in player_map(mesh).items() if dev.id in mine]

@@ -25,6 +25,7 @@ Design:
 from __future__ import annotations
 
 import logging
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Dict, Iterator, Optional, Tuple
@@ -102,15 +103,28 @@ class RawReducer:
         self.stats.device_seconds += time.perf_counter() - t0
         return out
 
-    def stream(self, raw: GuppiRaw) -> Iterator[np.ndarray]:
+    def stream(self, raw: GuppiRaw, skip_frames: int = 0) -> Iterator[np.ndarray]:
         """Yield float32 filterbank slabs ``(nspectra, nif, nchan*nfft)``
-        covering the file gap-free (PFB state carried across blocks)."""
+        covering the file gap-free (PFB state carried across blocks).
+
+        ``skip_frames`` skips the first N output frames exactly — frame N's
+        PFB window starts at sample ``N*nfft`` of the gap-free stream, so
+        skipping that many samples reproduces the remaining frames
+        bit-identically (the resume path of :meth:`reduce_resumable`).
+        """
         nfft, ntap, nint = self.nfft, self.ntap, self.nint
         chunk_samps = (self.chunk_frames + ntap - 1) * nfft
         advance = self.chunk_frames * nfft
+        to_skip = skip_frames * nfft
         t_wall = time.perf_counter()
         buf: Optional[np.ndarray] = None
         for _, block in raw.iter_blocks(drop_overlap=True):
+            if to_skip >= block.shape[1]:
+                to_skip -= block.shape[1]
+                continue
+            if to_skip:
+                block = block[:, to_skip:]
+                to_skip = 0
             block = np.ascontiguousarray(block)
             self.stats.input_bytes += block.nbytes
             buf = block if buf is None else np.concatenate([buf, block], axis=1)
@@ -163,6 +177,59 @@ class RawReducer:
             write_fil(out_path, hdr, data)
         return hdr
 
+    def reduce_resumable(self, raw_path: str, out_path: str) -> Dict:
+        """Reduce to a ``.fil`` product with crash-resumable streaming.
+
+        A :class:`ReductionCursor` sidecar records frames written after every
+        slab; re-running after an interruption truncates any un-checkpointed
+        tail and continues from the last completed chunk (block-boundary
+        restart, SURVEY.md §5 "Checkpoint / resume").  The finished product is
+        byte-identical to a non-resumed run; the sidecar is removed on
+        completion.
+        """
+        if out_path.endswith((".h5", ".hdf5")):
+            raise ValueError("reduce_resumable writes .fil (appendable) products")
+        from blit.io.sigproc import read_fil_header, write_fil
+
+        raw = GuppiRaw(raw_path)
+        if raw.nblocks == 0:
+            raise ValueError(f"empty or fully truncated RAW file: {raw_path}")
+        hdr = self.header_for(raw)
+        nif = STOKES_NIF[self.stokes]
+        spectrum_bytes = nif * hdr["nchans"] * 4  # float32 products
+
+        cur = ReductionCursor.load(out_path)
+        if cur is not None and cur.matches(self, raw_path) and os.path.exists(out_path):
+            _, data_off = read_fil_header(out_path)
+            good = data_off + (cur.frames_done // self.nint) * spectrum_bytes
+            with open(out_path, "r+b") as f:
+                f.truncate(good)  # drop any un-checkpointed partial slab
+            log.info("resuming %s at frame %d", out_path, cur.frames_done)
+        else:
+            write_fil(
+                out_path, hdr, np.zeros((0, nif, hdr["nchans"]), np.float32)
+            )
+            cur = ReductionCursor(
+                raw_path, self.nfft, self.ntap, self.nint, self.stokes, 0
+            )
+            cur.save(out_path)
+
+        nsamps = cur.frames_done // self.nint
+        with open(out_path, "ab") as f:
+            for slab in self.stream(raw, skip_frames=cur.frames_done):
+                np.ascontiguousarray(slab).tofile(f)
+                # Data must be durable BEFORE the cursor claims it, or a
+                # power loss could leave a cursor ahead of the bytes and the
+                # resume would zero-fill the gap.
+                f.flush()
+                os.fsync(f.fileno())
+                cur.frames_done += slab.shape[0] * self.nint
+                nsamps += slab.shape[0]
+                cur.save(out_path)
+        os.unlink(ReductionCursor.path_for(out_path))
+        hdr["nsamps"] = nsamps
+        return hdr
+
 
 # rawspec-equivalent product presets (SURVEY.md §0: products 0000/0001/0002).
 PRODUCT_PRESETS = {
@@ -178,3 +245,55 @@ def reducer_for_product(product: str, **kw) -> RawReducer:
     ``product`` ("0000" | "0001" | "0002")."""
     nfft, nint = PRODUCT_PRESETS[product]
     return RawReducer(nfft=nfft, nint=nint, **kw)
+
+
+@dataclass
+class ReductionCursor:
+    """Restart state for a streaming reduction, persisted as a JSON sidecar
+    next to the output product (SURVEY.md §5 "Checkpoint / resume":
+    stream-job cursors restarting at block boundaries).
+
+    ``frames_done`` counts raw PFB frames fully reduced *and written* — a
+    multiple of ``nint`` by construction, so resumption never re-splits an
+    integration window.
+    """
+
+    raw_path: str
+    nfft: int
+    ntap: int
+    nint: int
+    stokes: str
+    frames_done: int = 0
+
+    @staticmethod
+    def path_for(out_path: str) -> str:
+        return out_path + ".cursor"
+
+    def save(self, out_path: str) -> None:
+        import json
+
+        tmp = self.path_for(out_path) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.__dict__, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path_for(out_path))
+
+    @classmethod
+    def load(cls, out_path: str) -> Optional["ReductionCursor"]:
+        import json
+
+        try:
+            with open(cls.path_for(out_path)) as f:
+                return cls(**json.load(f))
+        except (OSError, ValueError, TypeError):
+            return None
+
+    def matches(self, red: "RawReducer", raw_path: str) -> bool:
+        return (
+            self.raw_path == raw_path
+            and self.nfft == red.nfft
+            and self.ntap == red.ntap
+            and self.nint == red.nint
+            and self.stokes == red.stokes
+        )

@@ -125,6 +125,7 @@ def reduce_raw(
     nfft: int = 1024,
     nint: int = 1,
     stokes: str = "I",
+    resume: bool = False,
     **reducer_kw,
 ):
     """Reduce a GUPPI RAW file to a filterbank product on this worker — the
@@ -136,6 +137,8 @@ def reduce_raw(
     reduction directly.  With ``out_path`` the product is written
     (``.fil``/``.h5`` by extension) and the output header returned; without
     it, ``(header, data)`` come back over the wire (small products only).
+    ``resume=True`` (with a ``.fil`` out_path) restarts an interrupted
+    reduction from its cursor sidecar (blit/pipeline.py ReductionCursor).
     """
     from blit.pipeline import RawReducer, reducer_for_product
 
@@ -148,5 +151,9 @@ def reduce_raw(
     else:
         red = RawReducer(nfft=nfft, nint=nint, stokes=stokes, **reducer_kw)
     if out_path is not None:
+        if resume:
+            return red.reduce_resumable(raw_path, out_path)
         return red.reduce_to_file(raw_path, out_path)
+    if resume:
+        raise ValueError("reduce_raw: resume=True requires a .fil out_path")
     return red.reduce(raw_path)
