@@ -110,3 +110,5 @@ def configure_logging(level: int = logging.INFO, worker: int = 0) -> None:
     )
     root.setLevel(level)
     root.addHandler(handler)
+    # Our handler owns blit output; don't duplicate through root handlers.
+    root.propagate = False

@@ -45,10 +45,16 @@ def init_multihost(
             )
         except RuntimeError as e:
             # jax 0.9 raises "should only be called once" on re-init and
-            # "must be called before any JAX calls" once a backend exists —
-            # both mean the process is already past bring-up.
+            # "must be called before any JAX calls" once a backend exists.
+            # The latter is only tolerable for implicit single-process
+            # bring-up — with an explicit coordinator the caller wanted a
+            # pod, and silently degrading would deadlock the collectives.
             msg = str(e).lower()
-            if "once" not in msg and "before any jax calls" not in msg:
+            if "once" in msg:
+                pass
+            elif "before any jax calls" in msg and coordinator_address is None:
+                log.info("backend already up without a cluster; single-process")
+            else:
                 raise
         except ValueError as e:
             # No cluster auto-detection and no explicit coordinator: a plain

@@ -26,13 +26,13 @@ from __future__ import annotations
 
 import logging
 import os
-import time
 from dataclasses import dataclass, field
 from typing import Dict, Iterator, Optional, Tuple
 
 import numpy as np
 
 from blit.io.guppi import GuppiRaw
+from blit.observability import Timeline
 from blit.ops.channelize import STOKES_NIF, channelize, output_header, pfb_coeffs
 
 log = logging.getLogger("blit.pipeline")
@@ -40,7 +40,8 @@ log = logging.getLogger("blit.pipeline")
 
 @dataclass
 class ReductionStats:
-    """Throughput counters (SURVEY.md §5 metrics plan)."""
+    """Aggregate throughput view derived from the reducer's stage
+    :class:`~blit.observability.Timeline` (SURVEY.md §5 metrics plan)."""
 
     input_bytes: int = 0
     output_frames: int = 0
@@ -69,10 +70,13 @@ class RawReducer:
     fft_method: str = "auto"
     # Output frames per device call; rounded up to a multiple of nint.
     chunk_frames: Optional[int] = None
-    stats: ReductionStats = field(default_factory=ReductionStats)
+    # Per-stage timing/byte registry ("ingest" / "device" / "stream").
+    timeline: Timeline = field(default_factory=Timeline)
 
     def __post_init__(self):
         import jax.numpy as jnp
+
+        self._output_frames = 0
 
         if self.chunk_frames is None:
             # Budget-driven default: ~8M samples per coarse channel per device
@@ -85,22 +89,32 @@ class RawReducer:
             self.chunk_frames += self.nint - self.chunk_frames % self.nint
         self._coeffs = jnp.asarray(pfb_coeffs(self.ntap, self.nfft, self.window))
 
+    @property
+    def stats(self) -> ReductionStats:
+        """Aggregate counters derived from :attr:`timeline`."""
+        st = self.timeline.stages
+        return ReductionStats(
+            input_bytes=st["ingest"].bytes,
+            output_frames=self._output_frames,
+            device_seconds=st["device"].seconds,
+            wall_seconds=st["stream"].seconds,
+        )
+
     # -- core streaming ---------------------------------------------------
     def _run_chunk(self, chunk: np.ndarray) -> np.ndarray:
         import jax
 
-        t0 = time.perf_counter()
-        out = channelize(
-            jax.numpy.asarray(chunk),
-            self._coeffs,
-            nfft=self.nfft,
-            ntap=self.ntap,
-            nint=self.nint,
-            stokes=self.stokes,
-            fft_method=self.fft_method,
-        )
-        out = np.asarray(jax.block_until_ready(out))
-        self.stats.device_seconds += time.perf_counter() - t0
+        with self.timeline.stage("device", nbytes=chunk.nbytes):
+            out = channelize(
+                jax.numpy.asarray(chunk),
+                self._coeffs,
+                nfft=self.nfft,
+                ntap=self.ntap,
+                nint=self.nint,
+                stokes=self.stokes,
+                fft_method=self.fft_method,
+            )
+            out = np.asarray(jax.block_until_ready(out))
         return out
 
     def stream(self, raw: GuppiRaw, skip_frames: int = 0) -> Iterator[np.ndarray]:
@@ -116,31 +130,33 @@ class RawReducer:
         chunk_samps = (self.chunk_frames + ntap - 1) * nfft
         advance = self.chunk_frames * nfft
         to_skip = skip_frames * nfft
-        t_wall = time.perf_counter()
         buf: Optional[np.ndarray] = None
-        for _, block in raw.iter_blocks(drop_overlap=True):
-            if to_skip >= block.shape[1]:
-                to_skip -= block.shape[1]
-                continue
-            if to_skip:
-                block = block[:, to_skip:]
-                to_skip = 0
-            block = np.ascontiguousarray(block)
-            self.stats.input_bytes += block.nbytes
-            buf = block if buf is None else np.concatenate([buf, block], axis=1)
-            while buf.shape[1] >= chunk_samps:
-                yield self._run_chunk(buf[:, :chunk_samps])
-                self.stats.output_frames += self.chunk_frames
-                buf = buf[:, advance:]
-        if buf is not None:
-            # Flush: whole frames remaining, rounded down to the integration.
-            frames = buf.shape[1] // nfft - ntap + 1
-            frames = (frames // nint) * nint if frames > 0 else 0
-            if frames > 0:
-                tail = buf[:, : (frames + ntap - 1) * nfft]
-                yield self._run_chunk(tail)
-                self.stats.output_frames += frames
-        self.stats.wall_seconds += time.perf_counter() - t_wall
+        with self.timeline.stage("stream"):
+            for _, block in raw.iter_blocks(drop_overlap=True):
+                if to_skip >= block.shape[1]:
+                    to_skip -= block.shape[1]
+                    continue
+                if to_skip:
+                    block = block[:, to_skip:]
+                    to_skip = 0
+                with self.timeline.stage("ingest", nbytes=block.nbytes):
+                    block = np.ascontiguousarray(block)
+                    buf = (
+                        block if buf is None
+                        else np.concatenate([buf, block], axis=1)
+                    )
+                while buf.shape[1] >= chunk_samps:
+                    yield self._run_chunk(buf[:, :chunk_samps])
+                    self._output_frames += self.chunk_frames
+                    buf = buf[:, advance:]
+            if buf is not None:
+                # Flush: whole frames remaining, rounded to the integration.
+                frames = buf.shape[1] // nfft - ntap + 1
+                frames = (frames // nint) * nint if frames > 0 else 0
+                if frames > 0:
+                    tail = buf[:, : (frames + ntap - 1) * nfft]
+                    yield self._run_chunk(tail)
+                    self._output_frames += frames
 
     # -- whole-file conveniences ------------------------------------------
     def header_for(self, raw: GuppiRaw) -> Dict:

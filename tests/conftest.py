@@ -28,3 +28,21 @@ if "jax" in sys.modules:  # sitecustomize already imported jax
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+
+
+import logging
+
+import pytest
+
+
+@pytest.fixture
+def blit_logger_restored():
+    """Snapshot + restore the 'blit' logger around tests that call
+    configure_logging (which sets propagate=False — that must not leak into
+    caplog-based tests)."""
+    root = logging.getLogger("blit")
+    handlers, propagate, level = list(root.handlers), root.propagate, root.level
+    yield
+    root.handlers = handlers
+    root.propagate = propagate
+    root.setLevel(level)

@@ -38,16 +38,12 @@ class TestTimeline:
             x = 1
         assert x == 1
 
-    def test_host_context_logging(self, capsys):
+    def test_host_context_logging(self, capsys, blit_logger_restored):
         logger = logging.getLogger("blit.testlog")
-        for h in list(logging.getLogger("blit").handlers):
-            logging.getLogger("blit").removeHandler(h)
         configure_logging(worker=7)
         logger.info("hello")
         err = capsys.readouterr().err
         assert "/w7" in err and "hello" in err
-        for h in list(logging.getLogger("blit").handlers):
-            logging.getLogger("blit").removeHandler(h)
 
 
 class TestInventoryPersistence:
@@ -179,13 +175,16 @@ class TestReviewRegressions:
         assert init_multihost() is False
         assert init_multihost() is False
 
-    def test_configure_logging_idempotent(self):
+    def test_configure_logging_idempotent(self, blit_logger_restored):
         root = logging.getLogger("blit")
-        before = len(root.handlers)
+        before = len(
+            [h for h in root.handlers if not getattr(h, "_blit_handler", False)]
+        )
         configure_logging(worker=1)
         configure_logging(worker=2)
         ours = [h for h in root.handlers if getattr(h, "_blit_handler", False)]
         assert len(ours) == 1
+        assert root.propagate is False  # no double emission via root
         for h in ours:
             root.removeHandler(h)
         assert len(root.handlers) == before
