@@ -30,6 +30,15 @@ from blit.parallel.pool import (  # noqa: F401 (re-export)
     setup_workers,
 )
 
+
+def load_scan_mesh(*args, **kw):
+    """Mesh-backed whole-scan reduction (RAW files -> sharded channelize ->
+    ICI band stitch); see :func:`blit.parallel.scan.load_scan_mesh`.  Lazy
+    wrapper so the host-only API keeps importing without JAX device state."""
+    from blit.parallel.scan import load_scan_mesh as _impl
+
+    return _impl(*args, **kw)
+
 log = logging.getLogger("blit.gbt")
 
 Idxs = Tuple

@@ -50,6 +50,15 @@ _DIRECT_FFT_MAX = 8192
 _MATMUL_ONLY_BACKENDS = ("tpu", "axon")
 
 
+def usable_frames(nsamps: int, nfft: int, ntap: int, nint: int) -> int:
+    """Whole PFB frames a gap-free span of ``nsamps`` samples yields, rounded
+    down to the integration length — THE frame-accounting invariant shared by
+    the streaming flush (blit/pipeline.py) and the mesh scan loader
+    (blit/parallel/scan.py)."""
+    frames = nsamps // nfft - ntap + 1
+    return (frames // nint) * nint if frames > 0 else 0
+
+
 def pfb_coeffs(ntap: int, nfft: int, window: str = "hamming") -> np.ndarray:
     """Windowed-sinc prototype filter for the polyphase frontend, shaped
     ``(ntap, nfft)`` and normalized to unit DC gain per fine channel.

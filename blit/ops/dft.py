@@ -146,12 +146,15 @@ def dft(
     if use_pallas and dtype != "float32":
         # The kernels hardcode f32 tiles/accumulators (pallas_dft.py).
         raise ValueError("use_pallas supports dtype='float32' only")
-    return _dft_rec(xr, xi, factors, precision, dtype, use_pallas)
+    # Off-TPU, the kernels run in pallas interpreter mode (slow, correct) so
+    # the flag is safe on every backend.
+    interpret = jax.default_backend() not in ("tpu", "axon")
+    return _dft_rec(xr, xi, factors, precision, dtype, use_pallas, interpret)
 
 
 def _dft_rec(
     xr: jax.Array, xi: jax.Array, factors: Tuple[int, ...], precision, dtype,
-    use_pallas: bool = False,
+    use_pallas: bool = False, interpret: bool = False,
 ) -> Planar:
     n = xr.shape[-1]
     if len(factors) == 1:
@@ -161,7 +164,8 @@ def _dft_rec(
         if use_pallas and n <= _PALLAS_MAX_N:
             from blit.ops.pallas_dft import dft_last
 
-            return dft_last(xr, xi, jnp.asarray(wr), jnp.asarray(wi))
+            return dft_last(xr, xi, jnp.asarray(wr), jnp.asarray(wi),
+                            interpret=interpret)
         return _cmatmul_last(xr, xi, jnp.asarray(wr), jnp.asarray(wi), precision)
     n1 = factors[0]
     n2 = n // n1
@@ -176,7 +180,7 @@ def _dft_rec(
     if use_pallas and n1 <= _PALLAS_MAX_N:
         from blit.ops.pallas_dft import dft_stage
 
-        ur, ui = dft_stage(xr_, xi_, w1r, w1i, tr, ti)
+        ur, ui = dft_stage(xr_, xi_, w1r, w1i, tr, ti, interpret=interpret)
     else:
         ar = jnp.einsum("kj,...jm->...km", w1r, xr_, precision=precision)
         ai = jnp.einsum("kj,...jm->...km", w1i, xr_, precision=precision)
@@ -186,7 +190,8 @@ def _dft_rec(
         ur = sr * tr - si * ti
         ui = sr * ti + si * tr
     # Recurse: n2-point DFTs along the rows (last axis).
-    vr, vi = _dft_rec(ur, ui, factors[1:], precision, dtype, use_pallas)
+    vr, vi = _dft_rec(ur, ui, factors[1:], precision, dtype, use_pallas,
+                      interpret)
     # Output index k = k1 + n1*k2: transpose (k1, k2) → (k2, k1) then flatten.
     vr = jnp.swapaxes(vr, -1, -2).reshape(batch + (n,))
     vi = jnp.swapaxes(vi, -1, -2).reshape(batch + (n,))

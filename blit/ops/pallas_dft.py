@@ -33,6 +33,22 @@ import jax.numpy as jnp
 _DEF_TILE_M = 512
 
 
+def _pick_tile(extent: int, target: int) -> int:
+    """Largest divisor of ``extent`` that is <= ``target`` — keeps tiles
+    VMEM-bounded for any extent instead of falling back to whole rows
+    (preferring lane-aligned multiples of 128 when one divides)."""
+    if extent <= target:
+        return extent
+    best = 1
+    for t in range(target, 0, -1):
+        if extent % t == 0:
+            if t % 128 == 0:
+                return t
+            if best == 1:
+                best = t
+    return best
+
+
 def _stage_kernel_tw(xr_ref, xi_ref, wr_ref, wi_ref, tr_ref, ti_ref,
                      or_ref, oi_ref):
     wr = wr_ref[...]
@@ -95,8 +111,7 @@ def dft_stage(
         b *= d
     xr3 = xr.reshape(b, n, m)
     xi3 = xi.reshape(b, n, m)
-    if m % tile_m:
-        tile_m = m  # fall back to whole rows (small m)
+    tile_m = _pick_tile(m, tile_m)
     grid = (b, m // tile_m)
 
     x_spec = pl.BlockSpec((1, n, tile_m), lambda i, j: (i, 0, j))
@@ -175,8 +190,7 @@ def dft_last(
         r *= d
     xr2 = xr.reshape(r, n)
     xi2 = xi.reshape(r, n)
-    if r % tile_r:
-        tile_r = r
+    tile_r = _pick_tile(r, tile_r)
     grid = (r // tile_r,)
     x_spec = pl.BlockSpec((tile_r, n), lambda i: (i, 0))
     w_spec = pl.BlockSpec((n, n), lambda i: (0, 0))

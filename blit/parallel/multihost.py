@@ -18,6 +18,21 @@ log = logging.getLogger("blit.multihost")
 
 _initialized = False
 
+# Environment markers that mean "this process is part of a pod/cluster" even
+# when no explicit coordinator_address argument was given.
+_CLUSTER_ENV_VARS = (
+    "COORDINATOR_ADDRESS",
+    "JAX_COORDINATOR_ADDRESS",
+    "MEGASCALE_COORDINATOR_ADDRESS",
+    "JAX_NUM_PROCESSES",
+)
+
+
+def _cluster_env_hints() -> bool:
+    import os
+
+    return any(os.environ.get(v) for v in _CLUSTER_ENV_VARS)
+
 
 def init_multihost(
     coordinator_address: Optional[str] = None,
@@ -52,9 +67,16 @@ def init_multihost(
             msg = str(e).lower()
             if "once" in msg:
                 pass
-            elif "before any jax calls" in msg and coordinator_address is None:
+            elif (
+                "before any jax calls" in msg
+                and coordinator_address is None
+                and not _cluster_env_hints()
+            ):
                 log.info("backend already up without a cluster; single-process")
             else:
+                # An intended pod (explicit coordinator, or cluster env vars
+                # present) must not silently degrade — the collectives would
+                # deadlock across hosts.  Initialize before any JAX call.
                 raise
         except ValueError as e:
             # No cluster auto-detection and no explicit coordinator: a plain

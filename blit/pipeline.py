@@ -33,7 +33,13 @@ import numpy as np
 
 from blit.io.guppi import GuppiRaw
 from blit.observability import Timeline
-from blit.ops.channelize import STOKES_NIF, channelize, output_header, pfb_coeffs
+from blit.ops.channelize import (
+    STOKES_NIF,
+    channelize,
+    output_header,
+    pfb_coeffs,
+    usable_frames,
+)
 
 log = logging.getLogger("blit.pipeline")
 
@@ -151,8 +157,7 @@ class RawReducer:
                     buf = buf[:, advance:]
             if buf is not None:
                 # Flush: whole frames remaining, rounded to the integration.
-                frames = buf.shape[1] // nfft - ntap + 1
-                frames = (frames // nint) * nint if frames > 0 else 0
+                frames = usable_frames(buf.shape[1], nfft, ntap, nint)
                 if frames > 0:
                     tail = buf[:, : (frames + ntap - 1) * nfft]
                     yield self._run_chunk(tail)
