@@ -1,0 +1,116 @@
+"""Per-host worker agent: ``python -m blit.agent``.
+
+The remote half of the ``backend="remote"`` worker pool
+(blit/parallel/remote.py) — the rebuild of the Julia worker process that
+``Distributed.addprocs`` starts over ssh and loads ``WorkerFunctions`` into
+(reference: src/gbt.jl:28-42).
+
+Protocol (stdin/stdout, logs on stderr):
+    banner   := b"BLITAGENT1\\n"            (emitted once at startup; the
+                                            client discards any ssh/rc noise
+                                            preceding it before framing)
+    request  := u64-le length | pickle((fn_path, args, kwargs))
+    response := u64-le length | pickle(("ok", result) | ("err", type, msg, tb))
+
+Two enforcement layers keep the wire from invoking arbitrary code:
+``fn_path`` must resolve inside the ``blit`` package, AND deserialization
+uses a restricted unpickler whose ``find_class`` only admits blit / numpy /
+stdlib-safe globals — a plain ``pickle.loads`` would execute attacker
+``__reduce__`` payloads before any allow-list ran.  One request is serviced
+at a time, matching the reference's one-``@spawnat``-at-a-time-per-worker
+usage.
+"""
+
+from __future__ import annotations
+
+import importlib
+import io
+import pickle
+import struct
+import sys
+import traceback
+
+MAGIC = b"BLITAGENT1\n"
+_LEN = struct.Struct("<Q")
+
+# Module prefixes whose globals may cross the wire (requests AND responses:
+# arguments are regexes/slices/arrays, results are arrays/records/dicts).
+_SAFE_MODULE_PREFIXES = ("blit", "numpy", "re")
+_SAFE_BUILTINS = frozenset(
+    {"slice", "complex", "range", "frozenset", "set", "bytearray"}
+)
+
+
+class _RestrictedUnpickler(pickle.Unpickler):
+    def find_class(self, module: str, name: str):
+        top = module.split(".", 1)[0]
+        if top in _SAFE_MODULE_PREFIXES:
+            return super().find_class(module, name)
+        if module == "builtins" and name in _SAFE_BUILTINS:
+            return super().find_class(module, name)
+        raise pickle.UnpicklingError(
+            f"agent wire refuses global {module}.{name}"
+        )
+
+
+def resolve(fn_path: str):
+    """Import and return a callable from a ``blit.``-prefixed dotted path."""
+    if not fn_path.startswith("blit."):
+        raise PermissionError(f"agent refuses non-blit callable {fn_path!r}")
+    mod_path, _, name = fn_path.rpartition(".")
+    fn = getattr(importlib.import_module(mod_path), name)
+    if not callable(fn):
+        raise TypeError(f"{fn_path} is not callable")
+    return fn
+
+
+def read_msg(stream) -> object:
+    head = stream.read(_LEN.size)
+    if len(head) < _LEN.size:
+        raise EOFError
+    (n,) = _LEN.unpack(head)
+    body = stream.read(n)
+    if len(body) < n:
+        raise EOFError
+    return _RestrictedUnpickler(io.BytesIO(body)).load()
+
+
+def write_msg(stream, obj) -> None:
+    body = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    stream.write(_LEN.pack(len(body)))
+    stream.write(body)
+    stream.flush()
+
+
+def serve(stdin=None, stdout=None) -> None:
+    """Blocking request loop; returns on EOF (pool shutdown / ssh drop)."""
+    stdin = stdin or sys.stdin.buffer
+    stdout = stdout or sys.stdout.buffer
+    while True:
+        try:
+            fn_path, args, kwargs = read_msg(stdin)
+        except EOFError:
+            return
+        try:
+            result = resolve(fn_path)(*args, **kwargs)
+            write_msg(stdout, ("ok", result))
+        except BaseException as e:  # noqa: BLE001 — everything crosses the wire
+            write_msg(
+                stdout,
+                ("err", type(e).__name__, str(e), traceback.format_exc()),
+            )
+
+
+def main() -> None:
+    # Anything the worker functions print must not corrupt the framing:
+    # repoint sys.stdout at stderr and keep the real fd for the protocol.
+    proto_out = sys.stdout.buffer
+    sys.stdout = io.TextIOWrapper(sys.stderr.buffer, line_buffering=True)
+    # Handshake: lets the client skip any ssh/rc banner noise ahead of us.
+    proto_out.write(MAGIC)
+    proto_out.flush()
+    serve(sys.stdin.buffer, proto_out)
+
+
+if __name__ == "__main__":
+    main()

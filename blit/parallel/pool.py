@@ -6,7 +6,11 @@ Rebuild of the reference's ``Distributed.addprocs``-over-ssh star topology
 - ``local``   — synchronous in-process calls (debugging, tests);
 - ``thread``  — one thread per worker (I/O-bound crawls and reads; the
   default, since the heavy lifting releases the GIL in NumPy/HDF5);
-- ``process`` — a process pool (CPU-bound host-side work).
+- ``process`` — a process pool (CPU-bound host-side work);
+- ``remote``  — one ``blit.agent`` subprocess per host over ssh
+  (blit/parallel/remote.py) — the true analog of the reference's
+  ``addprocs``-over-ssh workers, with calls routed to the host that owns
+  the files.
 
 Differences from the reference, by design (SURVEY.md §5 "Failure detection"):
 
@@ -46,6 +50,7 @@ class WorkerError:
 class _Worker:
     wid: int
     host: str
+    remote: Optional[object] = None  # RemoteWorker for backend="remote"
 
 
 class WorkerPool:
@@ -57,8 +62,13 @@ class WorkerPool:
         hosts: Sequence[str],
         backend: str = "thread",
         config: SiteConfig = DEFAULT,
+        transport: Optional[Callable[[str], Sequence[str]]] = None,
+        agent_env: Optional[dict] = None,
     ):
-        if backend not in ("local", "thread", "process"):
+        """``transport``/``agent_env`` apply to ``backend="remote"`` only:
+        ``transport(host)`` returns the agent-spawning command (default:
+        ``remote.ssh_command``); tests pass a local-subprocess transport."""
+        if backend not in ("local", "thread", "process", "remote"):
             raise ValueError(f"unknown backend {backend!r}")
         self.backend = backend
         self.config = config
@@ -68,12 +78,18 @@ class WorkerPool:
             _Worker(i + 1, h) for i, h in enumerate(hosts)
         ]
         self._exec = None
-        if backend == "thread":
+        if backend in ("thread", "remote"):
             self._exec = ThreadPoolExecutor(
                 max_workers=max(1, len(self.workers)), thread_name_prefix="blit-w"
             )
         elif backend == "process":
             self._exec = ProcessPoolExecutor()
+        if backend == "remote":
+            from blit.parallel.remote import RemoteWorker, ssh_command
+
+            make_cmd = transport or ssh_command
+            for w in self.workers:
+                w.remote = RemoteWorker(w.host, make_cmd(w.host), env=agent_env)
 
     # -- introspection ----------------------------------------------------
     @property
@@ -91,7 +107,12 @@ class WorkerPool:
         return len(self.workers)
 
     # -- execution --------------------------------------------------------
-    def _submit(self, fn: Callable, *args, **kw) -> Future:
+    def _submit(self, worker: _Worker, fn: Callable, /, *args, **kw) -> Future:
+        """Dispatch one call for ``worker``.  Shared-filesystem backends run
+        it anywhere; the remote backend routes it to that worker's host —
+        the reference's ``@spawnat worker`` placement (src/gbt.jl:54-57)."""
+        if worker.remote is not None:
+            return self._exec.submit(worker.remote.call, fn, *args, **kw)
         if self._exec is None:
             f: Future = Future()
             try:
@@ -114,9 +135,16 @@ class WorkerPool:
         (src/gbt.jl:54-57, 75-78).  Results are ordered like ``wids``."""
         if len(wids) != len(argtuples):
             raise ValueError("wids and argtuples must have the same length")
+        bad = [w for w in wids if not 1 <= w <= len(self.workers)]
+        if bad:
+            # wid 0 is the main process and negative/oversized ids are
+            # caller bugs — never let them alias a worker via indexing.
+            raise ValueError(f"invalid worker ids {bad}; valid range is "
+                             f"1..{len(self.workers)}")
         kwargs = kwargs or {}
         futures = [
-            self._submit(fn, *args, **kwargs) for args in argtuples
+            self._submit(self.workers[wid - 1], fn, *args, **kwargs)
+            for wid, args in zip(wids, argtuples)
         ]
         results: List[Any] = []
         for wid, fut in zip(wids, futures):
@@ -141,7 +169,7 @@ class WorkerPool:
         futures = []
         for w in self.workers:
             kw = kwargs_per_worker(w) if kwargs_per_worker else {}
-            futures.append(self._submit(fn, **kw))
+            futures.append(self._submit(w, fn, **kw))
         results = []
         for w, fut in zip(self.workers, futures):
             try:
@@ -155,9 +183,17 @@ class WorkerPool:
         return results
 
     def shutdown(self):
+        # Drain in-flight calls BEFORE closing agents — a queued remote call
+        # would otherwise respawn an agent nobody closes.
         if self._exec is not None:
             self._exec.shutdown(wait=True)
             self._exec = None
+        for w in self.workers:
+            if w.remote is not None:
+                try:
+                    w.remote.close()
+                except Exception as e:  # noqa: BLE001 — close the rest anyway
+                    log.warning("closing agent for %s failed: %s", w.host, e)
 
     def __enter__(self):
         return self
