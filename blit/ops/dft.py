@@ -25,7 +25,7 @@ from __future__ import annotations
 
 import functools
 import math
-from typing import Optional, Tuple
+from typing import Optional, Tuple, Union
 
 import numpy as np
 
@@ -37,6 +37,29 @@ import jax.numpy as jnp
 DIRECT_DFT_MAX = 4096
 
 Planar = Tuple[jax.Array, jax.Array]
+
+# A planar entry point's input: one complex array (CPU/GPU convenience) or a
+# planar (re, im) pair (the TPU-native form).
+ComplexOrPlanar = Union[jax.Array, Tuple[jax.Array, jax.Array]]
+
+
+def as_planar(x) -> Tuple[jax.Array, jax.Array, bool]:
+    """Normalize a complex array or a planar pair to ``(re, im,
+    was_complex)``.
+
+    The shared input-dispatch for every planar entry point (beamform,
+    correlator, …): planar ``(re, im)`` pairs — the TPU-native form — pass
+    through; complex arrays split (CPU/GPU convenience; the dispatch is
+    trace-time static since it keys on python type / dtype); real arrays get
+    a zero imaginary plane.
+    """
+    if isinstance(x, (tuple, list)):
+        xr, xi = x
+        return jnp.asarray(xr), jnp.asarray(xi), False
+    x = jnp.asarray(x)
+    if jnp.iscomplexobj(x):
+        return jnp.real(x), jnp.imag(x), True
+    return x, jnp.zeros_like(x), False
 
 
 @functools.lru_cache(maxsize=32)

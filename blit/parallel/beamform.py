@@ -12,6 +12,13 @@ antennas by the geometric-delay phasor and partially sums them (one MXU
 matmul over the antenna axis); the ``psum`` over the mesh axis completes the
 tied-array sum.  Detection + integration then reuse the single-chip kernels.
 
+TPU note: the compute is **planar** — complex values travel as ``(re, im)``
+pairs of float32 arrays, the blit-wide convention (blit/ops/dft.py), because
+this TPU backend implements no complex-dtype HLOs (DESIGN.md §1; not even
+complex ``device_put`` executes).  The public entry points accept either
+planar pairs (the TPU path) or complex arrays (CPU/GPU convenience — output
+dtype follows input).  One complex contraction = 4 real MXU einsums.
+
 The reference has no beamforming (it reads post-rawspec products) — this is
 the capability extension BASELINE.json prescribes, built so the per-chip
 math is plain jnp and the collective is a single explicit ``psum``.
@@ -29,90 +36,124 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from blit.ops.channelize import integrate
+from blit.ops.dft import ComplexOrPlanar, Planar, as_planar
 
 ANT_AXIS_DEFAULT = "bank"
+
+
+def delay_weights_planar(
+    delays_s: jax.Array,
+    freqs_hz: jax.Array,
+    amplitudes: Optional[jax.Array] = None,
+) -> Planar:
+    """Per-(beam, antenna, channel) phasors from geometric delays, planar.
+
+    ``delays_s``: (nbeam, nant) seconds; ``freqs_hz``: (nchan,) sky
+    frequencies of the coarse channels.  Returns ``(wr, wi)`` float32 pairs
+    shaped (nbeam, nant, nchan) holding ``cos/sin`` of ``-2π f τ`` —
+    real-valued trig only, so this runs on the complex-free TPU backend.
+    Optionally scaled by per-antenna ``amplitudes`` (nbeam, nant) or (nant,).
+    """
+    phase = (-2.0 * jnp.pi * delays_s[..., None] * freqs_hz[None, None, :]).astype(
+        jnp.float32
+    )
+    wr, wi = jnp.cos(phase), jnp.sin(phase)
+    if amplitudes is not None:
+        amp = jnp.asarray(amplitudes)
+        if amp.ndim == 1:
+            amp = amp[None, :]
+        wr = wr * amp[..., None]
+        wi = wi * amp[..., None]
+    return wr, wi
 
 
 def delay_weights(
     delays_s: jax.Array, freqs_hz: jax.Array, amplitudes: Optional[jax.Array] = None
 ) -> jax.Array:
-    """Per-(beam, antenna, channel) phasors from geometric delays.
+    """Complex-dtype convenience over :func:`delay_weights_planar`:
+    ``exp(-2πi f τ)`` shaped (nbeam, nant, nchan) complex64.  CPU/GPU only —
+    on the complex-free TPU backend use the planar form directly."""
+    wr, wi = delay_weights_planar(delays_s, freqs_hz, amplitudes)
+    return jax.lax.complex(wr, wi).astype(jnp.complex64)
 
-    ``delays_s``: (nbeam, nant) seconds; ``freqs_hz``: (nchan,) sky
-    frequencies of the coarse channels.  Returns complex64 weights
-    ``exp(-2πi f τ)`` shaped (nbeam, nant, nchan), optionally scaled by
-    per-antenna ``amplitudes`` (nbeam, nant) or (nant,).
+
+def _local_beams_planar(
+    vr: jax.Array, vi: jax.Array, wr: jax.Array, wi: jax.Array
+) -> Planar:
+    """Partial tied-array sum over this chip's antennas, planar.
+
+    ``v``: (nant_local, nchan, ntime, npol); ``w``: (nbeam, nant_local,
+    nchan).  Returns (nbeam, nchan, ntime, npol) partial beam voltages as a
+    (re, im) pair.  One complex contraction over antennas = 4 real batched
+    matmuls (MXU work); XLA fuses the combines.
     """
-    phase = -2.0 * jnp.pi * delays_s[..., None] * freqs_hz[None, None, :]
-    w = jnp.exp(1j * phase.astype(jnp.float32))
-    if amplitudes is not None:
-        amp = jnp.asarray(amplitudes)
-        if amp.ndim == 1:
-            amp = amp[None, :]
-        w = w * amp[..., None]
-    return w.astype(jnp.complex64)
-
-
-def _local_beams(v: jax.Array, w: jax.Array) -> jax.Array:
-    """Partial tied-array sum over this chip's antennas.
-
-    ``v``: (nant_local, nchan, ntime, npol) complex voltages;
-    ``w``: (nbeam, nant_local, nchan) weights.
-    Returns (nbeam, nchan, ntime, npol) partial beam voltages.  The
-    contraction over antennas is a batched matmul (MXU work).
-    """
-    return jnp.einsum("bac,actp->bctp", w, v)
+    rr = jnp.einsum("bac,actp->bctp", wr, vr)
+    ii = jnp.einsum("bac,actp->bctp", wi, vi)
+    ri = jnp.einsum("bac,actp->bctp", wr, vi)
+    ir = jnp.einsum("bac,actp->bctp", wi, vr)
+    return rr - ii, ri + ir
 
 
 @functools.partial(
     jax.jit, static_argnames=("mesh", "axis", "nint", "detect")
 )
 def beamform(
-    voltages: jax.Array,
-    weights: jax.Array,
+    voltages: ComplexOrPlanar,
+    weights: ComplexOrPlanar,
     *,
     mesh: Mesh,
     axis: str = ANT_AXIS_DEFAULT,
     nint: int = 1,
     detect: bool = True,
-) -> jax.Array:
+):
     """Form tied-array beams across the mesh.
 
     Args:
-      voltages: complex64 ``(nant, nchan, ntime, npol)``, antenna axis
-        sharded over ``axis`` (see :func:`antenna_sharding`).
-      weights: complex64 ``(nbeam, nant, nchan)`` phasors (antenna axis
-        sharded identically).
+      voltages: ``(nant, nchan, ntime, npol)`` antenna voltages — a planar
+        ``(re, im)`` float32 pair (TPU path) or one complex64 array (CPU/GPU
+        convenience).  Antenna axis sharded over ``axis`` (see
+        :func:`antenna_sharding`).
+      weights: ``(nbeam, nant, nchan)`` phasors from
+        :func:`delay_weights_planar` (planar) or :func:`delay_weights`
+        (complex), antenna axis sharded identically.
       detect: True → per-beam total power ``(nbeam, nchan, ntime_out, npol)``
         float32 integrated by ``nint``; False → raw beam voltages
-        ``(nbeam, nchan, ntime, npol)`` complex64 (for downstream fine
-        channelization).
+        ``(nbeam, nchan, ntime, npol)`` — planar pair unless *both* inputs
+        were complex (then complex64, for downstream fine channelization on
+        complex-capable backends).
 
     The only communication is one ``psum`` over ``axis`` — partial antenna
     sums travel, never raw voltages.
     """
-    def step(v, w):
-        beams = _local_beams(v, w)
-        beams = jax.lax.psum(beams, axis)
-        if detect:
-            p = (beams.real**2 + beams.imag**2).astype(jnp.float32)
-            # (nbeam, nchan, ntime, npol): integrate() groups along axis -2,
-            # which is time here.
-            return integrate(p, nint)
-        return beams
+    vr, vi, v_cplx = as_planar(voltages)
+    wr, wi, w_cplx = as_planar(weights)
+    complex_out = v_cplx and w_cplx
 
-    return jax.shard_map(
+    def step(vr, vi, wr, wi):
+        br, bi = _local_beams_planar(vr, vi, wr, wi)
+        br, bi = jax.lax.psum((br, bi), axis)
+        if detect:
+            return integrate((br**2 + bi**2).astype(jnp.float32), nint)
+        return br, bi
+
+    out_specs = P() if detect else (P(), P())
+    out = jax.shard_map(
         step,
         mesh=mesh,
-        in_specs=(P(axis), P(None, axis)),
-        out_specs=P(),
+        in_specs=(P(axis), P(axis), P(None, axis), P(None, axis)),
+        out_specs=out_specs,
         check_vma=False,  # psum output is axis-invariant
-    )(voltages, weights)
+    )(vr, vi, wr, wi)
+    if detect:
+        return out
+    br, bi = out
+    return jax.lax.complex(br, bi) if complex_out else (br, bi)
 
 
 def antenna_sharding(mesh: Mesh, axis: str = ANT_AXIS_DEFAULT) -> NamedSharding:
     """Sharding for (nant, nchan, ntime, npol) voltages: antennas over
-    ``axis``, everything else replicated."""
+    ``axis``, everything else replicated.  ``jax.device_put`` applies it to a
+    planar pair and a complex array alike (pytree leaves share it)."""
     return NamedSharding(mesh, P(axis))
 
 

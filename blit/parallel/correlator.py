@@ -14,16 +14,23 @@ collectives ride ICI):
   time segment, and the visibility integration completes with one ``psum``
   over ``band``.  That psum is the only collective in the correlator.
 
-Per chip: F-engine = the same PFB frontend + FFT as the single-chip
-filterbank path (blit/ops/channelize), applied to complex voltages; X-engine
-= one einsum forming the (ant, ant, fine-chan, pol, pol) products summed over
-frames — a batched matmul on the MXU.
+Per chip: F-engine = the same PFB frontend + planar matmul DFT as the
+single-chip filterbank path (blit/ops/channelize), applied to complex
+voltages held as ``(re, im)`` planes; X-engine = the baseline cross-products
+summed over frames — 4 real batched einsums per complex product on the MXU.
+
+TPU note: everything is **planar** (blit/ops/dft.py convention) because this
+TPU backend has no complex-dtype HLOs at all (DESIGN.md §1).  The public
+``correlate`` accepts planar pairs (TPU path) or complex arrays (CPU/GPU
+convenience; output dtype follows input).  The fftshift every fine spectrum
+needs is folded into the PFB window by the shift theorem — the same
+two-HBM-passes saving the filterbank path uses (DESIGN.md §2).
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Tuple
+from blit.ops.dft import ComplexOrPlanar, Planar, as_planar
 
 import numpy as np
 
@@ -31,55 +38,85 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from blit.ops.channelize import pfb_frontend
+from blit.ops.channelize import fft_planar, pfb_frontend
 
 BAND_AXIS = "band"
 BANK_AXIS = "bank"
 
 
-def f_engine(v: jax.Array, coeffs: jax.Array) -> jax.Array:
-    """Fine-channelize complex voltages: ``(..., ntime)`` →
-    ``(..., nframes, nfft)`` fftshifted spectra.
+def f_engine_planar(
+    vr: jax.Array, vi: jax.Array, coeffs: jax.Array
+) -> Planar:
+    """Fine-channelize complex voltages held as (re, im) planes:
+    ``(..., ntime)`` → ``(..., nframes, nfft)`` fftshifted planar spectra.
 
-    The complex-input twin of the filterbank path's PFB+FFT (the FIR runs on
-    the real/imag planes separately, so it stays real VPU work).
+    The complex-input twin of the filterbank path's PFB+FFT: the FIR runs on
+    each plane separately (real VPU work), the DFT is the planar matmul path
+    on TPU (complex FFT elsewhere, picked by ``fft_planar``), and the
+    fftshift is folded into the window coefficients via the shift theorem
+    (input sign flip ↔ spectrum roll by nfft/2; DESIGN.md §2).
     """
-    fr = pfb_frontend(v.real, coeffs)
-    fi = pfb_frontend(v.imag, coeffs)
-    return jnp.fft.fftshift(jnp.fft.fft(jax.lax.complex(fr, fi)), axes=-1)
+    ntap, nfft = coeffs.shape
+    if nfft % 2:
+        raise ValueError("f_engine_planar: nfft must be even")
+    sign = jnp.asarray(
+        np.where(np.arange(nfft) % 2 == 0, 1.0, -1.0).astype(np.float32)
+    )
+    shifted = coeffs * sign[None, :]
+    fr = pfb_frontend(vr, shifted)
+    fi = pfb_frontend(vi, shifted)
+    return fft_planar(fr, fi)
 
 
-def _xengine(spec: jax.Array) -> jax.Array:
-    """Cross-multiply and time-integrate.  ``spec``: (nant, nchan, npol,
-    nframes, nfft) → visibilities (nant, nant, nchan, nfft, npol, npol)."""
-    return jnp.einsum("acptf,bcqtf->abcfpq", spec, jnp.conj(spec))
+def f_engine(v: jax.Array, coeffs: jax.Array) -> jax.Array:
+    """Complex-dtype convenience over :func:`f_engine_planar` (CPU/GPU)."""
+    sr, si = f_engine_planar(jnp.real(v), jnp.imag(v), coeffs)
+    return jax.lax.complex(sr, si)
+
+
+def _xengine_planar(sr: jax.Array, si: jax.Array) -> Planar:
+    """Cross-multiply and time-integrate, planar.  ``s``: (nant, nchan, npol,
+    nframes, nfft) → visibilities (nant, nant, nchan, nfft, npol, npol) as a
+    (re, im) pair.
+
+    ``V[a,b] = Σ_t S_a S_b*``: with planar S the real part is
+    ``Σ (ar·br + ai·bi)`` and the imaginary part ``Σ (ai·br − ar·bi)`` —
+    4 real batched einsums (MXU) instead of one complex einsum.
+    """
+    rr = jnp.einsum("acptf,bcqtf->abcfpq", sr, sr)
+    ii = jnp.einsum("acptf,bcqtf->abcfpq", si, si)
+    ir = jnp.einsum("acptf,bcqtf->abcfpq", si, sr)
+    ri = jnp.einsum("acptf,bcqtf->abcfpq", sr, si)
+    return rr + ii, ir - ri
 
 
 @functools.partial(
     jax.jit, static_argnames=("mesh", "nfft", "ntap")
 )
 def correlate(
-    voltages: jax.Array,
+    voltages: ComplexOrPlanar,
     coeffs: jax.Array,
     *,
     mesh: Mesh,
     nfft: int,
     ntap: int = 4,
-) -> jax.Array:
+):
     """Full FX correlation over the mesh.
 
     Args:
-      voltages: complex64 ``(nant, nchan, ntime, npol)`` with ``nchan``
-        sharded over ``bank`` and ``ntime`` sharded over ``band`` (see
-        :func:`correlator_sharding`); ``ntime`` per band must be a multiple
-        of ``nfft`` with at least ``ntap`` blocks.
+      voltages: ``(nant, nchan, ntime, npol)`` — a planar ``(re, im)``
+        float32 pair (TPU path) or one complex64 array (CPU/GPU convenience)
+        with ``nchan`` sharded over ``bank`` and ``ntime`` sharded over
+        ``band`` (see :func:`correlator_sharding`); ``ntime`` per band must
+        be a multiple of ``nfft`` with at least ``ntap`` blocks.
       coeffs: (ntap, nfft) PFB prototype (replicated).
 
     Returns:
-      complex64 visibilities ``(nant, nant, nchan, nfft, npol, npol)``
-      integrated over *all* time (psum over ``band``), with the fine-channel
-      axes sharded over ``bank`` like the input.  Entry ``[a, b]`` is
-      ``⟨S_a S_b*⟩``; the diagonal holds autocorrelation spectra.
+      Visibilities ``(nant, nant, nchan, nfft, npol, npol)`` integrated over
+      *all* time (psum over ``band``), with the fine-channel axes sharded
+      over ``bank`` like the input — complex64 when the input was complex,
+      else a planar float32 pair.  Entry ``[a, b]`` is ``⟨S_a S_b*⟩``; the
+      diagonal holds autocorrelation spectra.
 
     Segment semantics: each band row F-engines its time segment
     independently — the PFB does not run across segment boundaries, so
@@ -87,26 +124,35 @@ def correlate(
     correlator behavior; :func:`correlate_np` with ``nsegments=nband`` is
     the exact golden reference).
     """
+    vr, vi, was_complex = as_planar(voltages)
 
-    def step(v, h):
+    def step(vr, vi, h):
         # v: (nant, nchan_local, ntime_local, npol) — move pol before time so
         # the F-engine framing acts on the last axis.
-        spec = f_engine(jnp.moveaxis(v, 3, 2), h)  # (a, c, p, frames, nfft)
-        vis = _xengine(spec)
-        return jax.lax.psum(vis, BAND_AXIS)
+        sr, si = f_engine_planar(
+            jnp.moveaxis(vr, 3, 2), jnp.moveaxis(vi, 3, 2), h
+        )  # (a, c, p, frames, nfft) each
+        visr, visi = _xengine_planar(sr, si)
+        return jax.lax.psum((visr, visi), BAND_AXIS)
 
-    return jax.shard_map(
+    spec_v = P(None, BANK_AXIS, BAND_AXIS)
+    out_spec = P(None, None, BANK_AXIS)
+    visr, visi = jax.shard_map(
         step,
         mesh=mesh,
-        in_specs=(P(None, BANK_AXIS, BAND_AXIS), P()),
-        out_specs=P(None, None, BANK_AXIS),
+        in_specs=(spec_v, spec_v, P()),
+        out_specs=(out_spec, out_spec),
         check_vma=False,  # psum output is band-invariant
-    )(voltages, coeffs)
+    )(vr, vi, coeffs)
+    if was_complex:
+        return jax.lax.complex(visr, visi)
+    return visr, visi
 
 
 def correlator_sharding(mesh: Mesh) -> NamedSharding:
     """Input sharding for (nant, nchan, ntime, npol) voltages: frequency
-    over ``bank``, time over ``band``."""
+    over ``bank``, time over ``band``.  ``jax.device_put`` applies it to a
+    planar pair and a complex array alike."""
     return NamedSharding(mesh, P(None, BANK_AXIS, BAND_AXIS))
 
 

@@ -14,6 +14,9 @@ import os
 # sitecustomize may have imported jax already, so the env var alone is not
 # enough — update the live config too (backends are not initialized yet at
 # conftest-import time, so this still takes effect).
+# Remember what the session pointed JAX at before we force CPU, so hardware
+# smoke tests (test_tpu_smoke.py) can target the real chip via subprocess.
+os.environ.setdefault("BLIT_HW_PLATFORMS", os.environ.get("JAX_PLATFORMS", ""))
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
