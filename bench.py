@@ -18,11 +18,19 @@ final sync — steady-state streaming with dispatch latency amortized, matching
 how blit.pipeline overlaps host IO with device work.  On non-TPU backends
 (dev machines) a small config keeps runtime sane; the reported config is in
 the JSON's "config" field either way.
+
+Robustness: the remote-compile tunnel can hiccup transiently, and a failed
+op can poison the whole JAX process — so each measurement attempt runs in a
+fresh subprocess (``--single <config>``), and the orchestrator retries with
+backoff, falling back to a smaller config if the primary keeps failing.  A
+JSON line is always printed (round 1 lost its official perf number to a
+single un-retried warmup error).
 """
 
 from __future__ import annotations
 
 import json
+import subprocess
 import sys
 import time
 
@@ -31,22 +39,33 @@ import numpy as np
 # Per-bank recording rate: 187.5 Msamp/s x 2 pol x 2 bytes (SURVEY.md §6).
 REALTIME_BANK_GBPS = 0.750
 
+# (nfft, ntap, nint, nchan, frames, channel_block, K calls)
+_CONFIGS = {
+    # Hi-res product, sized to HBM: 32 coarse channels x 5 frames of
+    # 2^20-point channelization per dispatch (671 MB net per call;
+    # measured 4.4 GB/s = 5.8x real-time on a v5e chip).
+    "tpu": (1 << 20, 4, 1, 32, 5, 0, 8),
+    # Fallback under repeated failures: same hi-res metric, half the
+    # working set per dispatch.
+    "tpu_small": (1 << 20, 4, 1, 16, 3, 0, 8),
+    # Dev machines (CPU): keep runtime sane.
+    "cpu": (1 << 14, 4, 1, 4, 4, 0, 4),
+}
 
-def main() -> None:
+_ATTEMPTS_PER_CONFIG = 3
+_BACKOFF_S = (5.0, 20.0)
+_ATTEMPT_TIMEOUT_S = 1500.0
+
+
+def run_single(config_name: str) -> None:
+    """One measurement in this process; prints the JSON line on success."""
     import jax
     import jax.numpy as jnp
 
     from blit.ops.channelize import channelize, pfb_coeffs
 
     backend = jax.default_backend()
-    on_tpu = backend in ("tpu", "axon")
-    if on_tpu:
-        # Hi-res product, sized to HBM: 32 coarse channels x 5 frames of
-        # 2^20-point channelization per dispatch (671 MB net per call;
-        # measured 4.4 GB/s = 5.8x real-time on a v5e chip).
-        nfft, ntap, nint, nchan, frames, cb, K = 1 << 20, 4, 1, 32, 5, 0, 8
-    else:
-        nfft, ntap, nint, nchan, frames, cb, K = 1 << 14, 4, 1, 4, 4, 0, 4
+    nfft, ntap, nint, nchan, frames, cb, K = _CONFIGS[config_name]
 
     ntime = (ntap - 1 + frames) * nfft
     rng = np.random.default_rng(0)
@@ -80,6 +99,7 @@ def main() -> None:
         "vs_baseline": round(gbps / REALTIME_BANK_GBPS, 2),
         "config": {
             "backend": backend,
+            "name": config_name,
             "nfft": nfft,
             "ntap": ntap,
             "nint": nint,
@@ -92,6 +112,73 @@ def main() -> None:
         },
     }
     print(json.dumps(result))
+
+
+def _probe_backend() -> str:
+    """Backend name, probed in a SUBPROCESS — the orchestrator must never
+    initialize JAX itself, or it would hold the chip for its whole lifetime
+    and starve every ``--single`` child of the device."""
+    proc = subprocess.run(
+        [sys.executable, "-c", "import jax; print(jax.default_backend())"],
+        capture_output=True, text=True, timeout=180,
+    )
+    lines = proc.stdout.strip().splitlines()
+    if proc.returncode != 0 or not lines:
+        raise RuntimeError(proc.stderr.strip().splitlines()[-1:] or "probe failed")
+    return lines[-1]
+
+
+def main() -> int:
+    if len(sys.argv) >= 3 and sys.argv[1] == "--single":
+        run_single(sys.argv[2])
+        return 0
+
+    try:
+        backend = _probe_backend()
+    except Exception:
+        backend = ""  # probe hiccup: try the chip, but keep the cpu fallback
+    if backend == "cpu":
+        config_names = ["cpu"]
+    elif backend in ("tpu", "axon"):
+        config_names = ["tpu", "tpu_small"]
+    else:
+        config_names = ["tpu", "tpu_small", "cpu"]
+
+    last_err = "no attempts ran"
+    for config_name in config_names:
+        for attempt in range(_ATTEMPTS_PER_CONFIG):
+            try:
+                proc = subprocess.run(
+                    [sys.executable, __file__, "--single", config_name],
+                    capture_output=True, text=True,
+                    timeout=_ATTEMPT_TIMEOUT_S,
+                )
+            except subprocess.TimeoutExpired:
+                last_err = f"{config_name}#{attempt}: timeout"
+                continue
+            for line in reversed(proc.stdout.strip().splitlines()):
+                try:
+                    result = json.loads(line)
+                except (json.JSONDecodeError, ValueError):
+                    continue
+                print(line)
+                return 0
+            last_err = (
+                f"{config_name}#{attempt} rc={proc.returncode}: "
+                + (proc.stderr.strip().splitlines() or ["no stderr"])[-1]
+            )
+            if attempt + 1 < _ATTEMPTS_PER_CONFIG:
+                time.sleep(_BACKOFF_S[min(attempt, len(_BACKOFF_S) - 1)])
+
+    # Every attempt failed: still emit a parseable record.
+    print(json.dumps({
+        "metric": "guppi_raw_to_hires_filterbank_GBps_per_chip",
+        "value": 0.0,
+        "unit": "GB/s",
+        "vs_baseline": 0.0,
+        "error": last_err,
+    }))
+    return 0
 
 
 if __name__ == "__main__":

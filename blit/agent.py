@@ -12,19 +12,22 @@ Protocol (stdin/stdout, logs on stderr):
     request  := u64-le length | pickle((fn_path, args, kwargs))
     response := u64-le length | pickle(("ok", result) | ("err", type, msg, tb))
 
-Two enforcement layers keep the wire from invoking arbitrary code:
-``fn_path`` must resolve inside the ``blit`` package, AND deserialization
-uses a restricted unpickler whose ``find_class`` only admits blit / numpy /
-stdlib-safe globals — a plain ``pickle.loads`` would execute attacker
-``__reduce__`` payloads before any allow-list ran.  One request is serviced
-at a time, matching the reference's one-``@spawnat``-at-a-time-per-worker
-usage.
+Three enforcement layers keep the wire from invoking arbitrary code or
+exhausting memory: ``fn_path`` must resolve inside the ``blit`` package; the
+length header is capped (:data:`MAX_MSG_BYTES`) before any allocation; and
+deserialization uses a restricted unpickler whose ``find_class`` admits only
+an exact (module, name) allow-list of value constructors / reconstructors /
+pure reducers — module-prefix trust would let pickle REDUCE invoke any
+callable in an admitted namespace with attacker-chosen arguments.  One
+request is serviced at a time, matching the reference's
+one-``@spawnat``-at-a-time-per-worker usage.
 """
 
 from __future__ import annotations
 
 import importlib
 import io
+import os
 import pickle
 import struct
 import sys
@@ -33,9 +36,44 @@ import traceback
 MAGIC = b"BLITAGENT1\n"
 _LEN = struct.Struct("<Q")
 
-# Module prefixes whose globals may cross the wire (requests AND responses:
-# arguments are regexes/slices/arrays, results are arrays/records/dicts).
-_SAFE_MODULE_PREFIXES = ("blit", "numpy", "re")
+# Upper bound on one framed message, enforced BEFORE the body buffer is
+# allocated — an untrusted length header must not be able to force multi-GB
+# allocations.  Full data slabs legitimately cross the wire (reference
+# semantics: whole arrays travel main-ward, src/gbt.jl:78), so the default is
+# generous; deployments can tighten or widen it via the env var.
+MAX_MSG_BYTES = int(
+    os.environ.get("BLIT_AGENT_MAX_MSG_BYTES", str(8 << 30))
+)
+
+# Exact globals that may cross the wire, (module, qualname) pairs — NOT
+# module prefixes: pickle REDUCE can call any admitted callable with
+# attacker-chosen arguments, so each entry must be safe to invoke blind
+# (value constructors / reconstructors / pure reducers only).  Requests carry
+# regexes/slices/arrays/records; responses carry arrays/records/dicts.
+_SAFE_GLOBALS = frozenset({
+    # numpy array/scalar reconstruction — numpy 2.x paths...
+    ("numpy", "ndarray"),
+    ("numpy", "dtype"),
+    ("numpy._core.numeric", "_frombuffer"),
+    ("numpy._core.multiarray", "_reconstruct"),
+    ("numpy._core.multiarray", "scalar"),
+    # ...and their numpy 1.x spellings (a remote host may run 1.x).
+    ("numpy.core.numeric", "_frombuffer"),
+    ("numpy.core.multiarray", "_reconstruct"),
+    ("numpy.core.multiarray", "scalar"),
+    # Pure elementwise/axis reducers users pass as ``fqav_func``.
+    ("numpy", "sum"), ("numpy", "mean"), ("numpy", "median"),
+    ("numpy", "max"), ("numpy", "min"), ("numpy", "amax"),
+    ("numpy", "amin"), ("numpy", "nansum"), ("numpy", "nanmean"),
+    ("numpy", "std"), ("numpy", "var"),
+    ("numpy", "nanmedian"), ("numpy", "nanmax"), ("numpy", "nanmin"),
+    # Compiled regex patterns (inventory filters).
+    ("re", "_compile"),
+    # blit record types that legitimately cross the wire.
+    ("blit.inventory", "InventoryRecord"),
+    ("blit.naming", "GuppiName"),
+    ("blit.config", "SiteConfig"),
+})
 _SAFE_BUILTINS = frozenset(
     {"slice", "complex", "range", "frozenset", "set", "bytearray"}
 )
@@ -43,8 +81,7 @@ _SAFE_BUILTINS = frozenset(
 
 class _RestrictedUnpickler(pickle.Unpickler):
     def find_class(self, module: str, name: str):
-        top = module.split(".", 1)[0]
-        if top in _SAFE_MODULE_PREFIXES:
+        if (module, name) in _SAFE_GLOBALS:
             return super().find_class(module, name)
         if module == "builtins" and name in _SAFE_BUILTINS:
             return super().find_class(module, name)
@@ -64,11 +101,31 @@ def resolve(fn_path: str):
     return fn
 
 
-def read_msg(stream) -> object:
+def read_msg(stream, max_bytes: int = 0) -> object:
+    """Read one framed message.  The length header is untrusted: it is
+    validated against ``max_bytes`` (default :data:`MAX_MSG_BYTES`) before
+    any buffer is allocated.
+
+    On an oversized header the body is consumed in bounded chunks and
+    discarded before :class:`pickle.UnpicklingError` is raised, so the
+    stream stays framed and the peer can keep servicing requests.
+    """
     head = stream.read(_LEN.size)
     if len(head) < _LEN.size:
         raise EOFError
     (n,) = _LEN.unpack(head)
+    limit = max_bytes or MAX_MSG_BYTES
+    if n > limit:
+        remaining = n
+        while remaining > 0:
+            chunk = stream.read(min(remaining, 1 << 20))
+            if not chunk:
+                break  # peer hung up mid-body; refusal below still applies
+            remaining -= len(chunk)
+        raise pickle.UnpicklingError(
+            f"agent wire message of {n} bytes exceeds the "
+            f"{limit}-byte limit (BLIT_AGENT_MAX_MSG_BYTES)"
+        )
     body = stream.read(n)
     if len(body) < n:
         raise EOFError
@@ -91,6 +148,12 @@ def serve(stdin=None, stdout=None) -> None:
             fn_path, args, kwargs = read_msg(stdin)
         except EOFError:
             return
+        except pickle.UnpicklingError as e:
+            # A refused request (oversized or disallowed global) must not
+            # kill the worker: the stream is still framed (read_msg consumed
+            # the body), so report the refusal and keep serving.
+            write_msg(stdout, ("err", "UnpicklingError", str(e), ""))
+            continue
         try:
             result = resolve(fn_path)(*args, **kwargs)
             write_msg(stdout, ("ok", result))

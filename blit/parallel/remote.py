@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import logging
 import os
+import pickle
 import subprocess
 import sys
 import threading
@@ -132,6 +133,17 @@ class RemoteWorker:
                 raise RemoteError(
                     self.host, "AgentDied",
                     f"agent exited (rc={rc}) during {fn_path}: {e}", "",
+                ) from e
+            except pickle.UnpicklingError as e:
+                # A refused response (oversized / disallowed global) means
+                # the peer is misbehaving or compromised; don't trust the
+                # stream again — kill and respawn on next use.
+                proc.kill()
+                proc.wait()
+                self._proc = None
+                raise RemoteError(
+                    self.host, "WireRefused",
+                    f"response refused during {fn_path}: {e}", "",
                 ) from e
         if reply[0] == "ok":
             return reply[1]

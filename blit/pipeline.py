@@ -230,8 +230,10 @@ class RawReducer:
             write_fil(
                 out_path, hdr, np.zeros((0, nif, hdr["nchans"]), np.float32)
             )
+            size, mtime_ns = ReductionCursor.stat_raw(raw_path)
             cur = ReductionCursor(
-                raw_path, self.nfft, self.ntap, self.nint, self.stokes, 0
+                raw_path, self.nfft, self.ntap, self.nint, self.stokes, 0,
+                window=self.window, raw_size=size, raw_mtime_ns=mtime_ns,
             )
             cur.save(out_path)
 
@@ -277,6 +279,11 @@ class ReductionCursor:
     ``frames_done`` counts raw PFB frames fully reduced *and written* — a
     multiple of ``nint`` by construction, so resumption never re-splits an
     integration window.
+
+    Identity guards: the full reduction config *including the PFB window*
+    must match, and the RAW input must be the same bytes it was
+    (size + mtime_ns recorded at cursor creation) — otherwise a resume would
+    silently splice spectra from different configs/inputs into one product.
     """
 
     raw_path: str
@@ -285,6 +292,14 @@ class ReductionCursor:
     nint: int
     stokes: str
     frames_done: int = 0
+    window: str = "hamming"
+    raw_size: int = -1
+    raw_mtime_ns: int = -1
+
+    @staticmethod
+    def stat_raw(raw_path: str) -> Tuple[int, int]:
+        st = os.stat(raw_path)
+        return st.st_size, st.st_mtime_ns
 
     @staticmethod
     def path_for(out_path: str) -> str:
@@ -311,10 +326,17 @@ class ReductionCursor:
             return None
 
     def matches(self, red: "RawReducer", raw_path: str) -> bool:
+        try:
+            size, mtime_ns = self.stat_raw(raw_path)
+        except OSError:
+            return False
         return (
             self.raw_path == raw_path
             and self.nfft == red.nfft
             and self.ntap == red.ntap
             and self.nint == red.nint
             and self.stokes == red.stokes
+            and self.window == red.window
+            and self.raw_size == size
+            and self.raw_mtime_ns == mtime_ns
         )

@@ -135,6 +135,40 @@ class TestResumableReduction:
         hdr = red.reduce_resumable(raw, out)
         assert hdr["nsamps"] > 0
 
+    def test_window_mismatch_restarts(self, tmp_path):
+        from blit.pipeline import RawReducer, ReductionCursor
+
+        raw, red = self._setup(tmp_path)
+        out = str(tmp_path / "x.fil")
+        size, mtime_ns = ReductionCursor.stat_raw(raw)
+        # Same nfft/ntap/nint/stokes but a different PFB window: resuming
+        # would splice spectra from two different filters into one product.
+        cur = ReductionCursor(
+            raw, nfft=64, ntap=4, nint=2, stokes="I", frames_done=2,
+            window="hanning", raw_size=size, raw_mtime_ns=mtime_ns,
+        )
+        assert not cur.matches(red, raw)
+
+    def test_modified_raw_input_restarts(self, tmp_path):
+        from blit.pipeline import RawReducer, ReductionCursor
+
+        raw, red = self._setup(tmp_path)
+        out = str(tmp_path / "x.fil")
+        size, mtime_ns = ReductionCursor.stat_raw(raw)
+        cur = ReductionCursor(
+            raw, nfft=64, ntap=4, nint=2, stokes="I", frames_done=2,
+            window=red.window, raw_size=size, raw_mtime_ns=mtime_ns,
+        )
+        assert cur.matches(red, raw)
+        # Append a byte: the input is no longer what the cursor described.
+        with open(raw, "ab") as f:
+            f.write(b"\0")
+        assert not cur.matches(red, raw)
+        # Legacy cursor without identity fields must not match either.
+        legacy = ReductionCursor(raw, nfft=64, ntap=4, nint=2, stokes="I",
+                                 frames_done=2)
+        assert not legacy.matches(red, raw)
+
     def test_h5_rejected(self, tmp_path):
         raw, red = self._setup(tmp_path)
         with pytest.raises(ValueError, match=r"\.fil"):

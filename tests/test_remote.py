@@ -53,6 +53,33 @@ class TestAgentProtocol:
         tag, result = read_msg(out)
         assert tag == "ok" and result == (2.5, 4.0, 1)
 
+    def test_serve_survives_refused_request(self):
+        # A refused pickle must produce an ("err", ...) reply — not kill the
+        # worker — and the NEXT request on the same stream must still work
+        # (the framing survives the refusal).
+        import pickle
+
+        class Evil:
+            def __reduce__(self):
+                import os
+
+                return (os.system, ("true",))
+
+        from blit.agent import _LEN
+
+        inbuf = io.BytesIO()
+        body = pickle.dumps((Evil(), (), {}))
+        inbuf.write(_LEN.pack(len(body)) + body)
+        write_msg(inbuf, ("blit.ops.fqav.fqav_range", (1.0, 1.0, 4, 4), {}))
+        inbuf.seek(0)
+        out = io.BytesIO()
+        serve(inbuf, out)
+        out.seek(0)
+        tag, etype, msg, _tb = read_msg(out)
+        assert tag == "err" and etype == "UnpicklingError" and "refuses" in msg
+        tag, result = read_msg(out)
+        assert tag == "ok" and result == (2.5, 4.0, 1)
+
     def test_serve_ships_exceptions(self):
         inbuf = io.BytesIO()
         write_msg(inbuf, ("blit.workers.get_header", ("/nonexistent.fil",), {}))
@@ -201,6 +228,53 @@ class TestHardening:
         np.testing.assert_array_equal(back[0], payload[0])
         assert back[1].pattern == payload[1].pattern
         assert back[2] == slice(1, 5, 2) and back[3] == payload[3]
+
+    def test_admitted_namespace_callables_rejected(self):
+        # Module-prefix trust would let REDUCE invoke e.g. numpy.save or a
+        # blit worker function with attacker args; the allow-list is exact
+        # (module, name) pairs, so these must all refuse.
+        import pickle
+
+        from blit.agent import _RestrictedUnpickler
+
+        for module, name in [
+            ("numpy", "save"),
+            ("numpy", "fromfile"),
+            ("numpy.lib.npyio", "save"),
+            ("blit.workers", "reduce_raw"),
+            ("blit.io.sigproc", "write_fil"),
+            ("re", "sub"),
+        ]:
+            with pytest.raises(pickle.UnpicklingError, match="refuses"):
+                _RestrictedUnpickler(io.BytesIO(b"")).find_class(module, name)
+
+    def test_oversized_length_header_rejected_before_allocation(self):
+        # A lying u64 header must not trigger a giant allocation: the cap
+        # check runs before the body read.
+        import pickle
+
+        from blit.agent import read_msg, _LEN
+
+        stream = io.BytesIO(_LEN.pack(1 << 62))
+        with pytest.raises(pickle.UnpicklingError, match="exceeds"):
+            read_msg(stream)
+        # Within an explicit cap: frames normally.
+        import pickle as pkl
+
+        body = pkl.dumps([1, 2, 3])
+        stream = io.BytesIO(_LEN.pack(len(body)) + body)
+        assert read_msg(stream, max_bytes=1 << 20) == [1, 2, 3]
+
+    def test_fqav_reducers_cross_the_wire(self):
+        # np.mean / np.sum are the documented fqav_func values; they must
+        # survive the exact-symbol allow-list.
+        from blit.agent import read_msg, write_msg
+
+        buf = io.BytesIO()
+        write_msg(buf, (np.mean, np.sum, np.max))
+        buf.seek(0)
+        back = read_msg(buf)
+        assert back[0] is np.mean and back[1] is np.sum and back[2] is np.max
 
     def test_banner_noise_skipped(self):
         # An rc file that echoes garbage before the agent starts must not

