@@ -96,13 +96,31 @@ class GuppiRaw:
     """One GUPPI RAW file: indexed access to (header, voltage-block) pairs.
 
     Scans block boundaries once at construction (headers only — cheap), then
-    reads blocks on demand via memmap slices so large files never fully load.
+    reads blocks on demand.  When the native threaded reader is built
+    (``make -C blit/native``) block reads fan ``pread`` across threads at
+    storage/pagecache bandwidth — the GB/s host-side feed SURVEY.md §2.3
+    prescribes; otherwise single-threaded memmap slices serve (large files
+    still never fully load).
+
+    ``native``: ``None`` auto-detects the built library; ``True`` requires
+    it; ``False`` forces the memmap path.
     """
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, native: Optional[bool] = None):
         self.path = path
         self.headers: List[Dict] = []
         self._data_offsets: List[int] = []
+        if native is None or native:
+            from blit.io.native import guppi_lib
+
+            have = guppi_lib() is not None
+            if native and not have:
+                raise RuntimeError(
+                    "native GUPPI reader unbuilt: make -C blit/native"
+                )
+            self.native = have
+        else:
+            self.native = False
         with open(path, "rb") as f:
             size = os.path.getsize(path)
             while True:
@@ -123,23 +141,95 @@ class GuppiRaw:
     def header(self, i: int = 0) -> Dict:
         return self.headers[i]
 
-    def read_block(self, i: int) -> np.ndarray:
-        """Raw int8 voltages of block ``i``, shaped
-        ``(obsnchan, ntime, npol, 2)`` (last axis = re, im)."""
+    def _block_geometry(self, i: int) -> Tuple[int, int, int]:
+        """(nchan, ntime, npol) of block ``i`` after NBITS validation."""
         hdr = self.headers[i]
         nbits = hdr.get("NBITS", 8)
         if nbits != 8:
             raise NotImplementedError(f"NBITS={nbits} not supported (GBT uses 8)")
         npol = 2 if hdr["NPOL"] > 2 else hdr["NPOL"]
-        ntime = block_ntime(hdr)
+        return hdr["OBSNCHAN"], block_ntime(hdr), npol
+
+    def read_block(self, i: int) -> np.ndarray:
+        """Raw int8 voltages of block ``i``, shaped
+        ``(obsnchan, ntime, npol, 2)`` (last axis = re, im).
+
+        Native path: one threaded read into a fresh buffer.  Fallback: a lazy
+        memmap view (pages in on consumption, single-threaded)."""
+        nchan, ntime, npol = self._block_geometry(i)
+        shape = (nchan, ntime, npol, 2)
+        if self.native:
+            from blit.io.native import guppi_pread
+
+            nbytes = nchan * ntime * npol * 2
+            buf = guppi_pread(self.path, self._data_offsets[i], nbytes)
+            return buf.view(np.int8).reshape(shape)
+        return np.memmap(
+            self.path,
+            dtype=np.int8,
+            mode="r",
+            offset=self._data_offsets[i],
+            shape=shape,
+        )
+
+    def read_block_into(
+        self, i: int, dst: np.ndarray, t0: int = 0, ntime_keep: int = -1
+    ) -> int:
+        """Read samples ``[t0, t0+ntime_keep)`` of every channel of block
+        ``i`` directly into ``dst[:, :ntime_keep]`` — the zero-intermediate-
+        copy feed for the streaming ring buffer (blit/pipeline.py).
+
+        ``dst``: int8 ``(nchan, >=ntime_keep, npol, 2)`` with C-contiguous
+        rows (a time-slice view of a C-contiguous ring buffer qualifies).
+        ``ntime_keep=-1`` means through the end of the block.  Returns the
+        samples written.  Uses the native strided pread when built, else a
+        memmap copy.
+        """
+        nchan, ntime, npol = self._block_geometry(i)
+        if ntime_keep < 0:
+            ntime_keep = ntime - t0
+        if t0 < 0 or t0 + ntime_keep > ntime:
+            raise ValueError(
+                f"read_block_into: [{t0}, {t0 + ntime_keep}) outside block "
+                f"of {ntime} samples"
+            )
+        if dst.dtype != np.int8 or dst.shape[0] != nchan or dst.shape[2:] != (npol, 2):
+            raise ValueError("read_block_into: dst shape/dtype mismatch")
+        if ntime_keep == 0:
+            return 0
+        samp_bytes = npol * 2
+        if self.native and dst[0].flags.c_contiguous:
+            from blit.io.native import guppi_pread_strided
+
+            guppi_pread_strided(
+                self.path,
+                self._data_offsets[i] + t0 * samp_bytes,
+                nchan,
+                ntime_keep * samp_bytes,
+                ntime * samp_bytes,
+                dst,
+                dst.strides[0],
+            )
+            return ntime_keep
         mm = np.memmap(
             self.path,
             dtype=np.int8,
             mode="r",
             offset=self._data_offsets[i],
-            shape=(hdr["OBSNCHAN"], ntime, npol, 2),
+            shape=(nchan, ntime, npol, 2),
         )
-        return mm
+        dst[:, :ntime_keep] = mm[:, t0 : t0 + ntime_keep]
+        return ntime_keep
+
+    def block_ntime_kept(self, i: int) -> int:
+        """Time samples block ``i`` contributes to the gap-free stream: its
+        trailing ``OVERLAP`` samples repeat at the start of the next block,
+        so every block but the last drops them."""
+        hdr = self.headers[i]
+        nt = block_ntime(hdr)
+        if i < self.nblocks - 1:
+            nt -= hdr.get("OVERLAP", 0)
+        return nt
 
     def read_block_complex(self, i: int) -> np.ndarray:
         """Block ``i`` as complex64, shaped ``(obsnchan, ntime, npol)``."""

@@ -44,8 +44,58 @@ def guppi_lib() -> Optional[ctypes.CDLL]:
         ctypes.c_void_p,
         ctypes.c_int,
     ]
+    if not hasattr(lib, "blit_guppi_pread2"):
+        return None  # stale build; rebuild with make -C blit/native
+    lib.blit_guppi_pread2.restype = ctypes.c_int
+    lib.blit_guppi_pread2.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_uint64,
+        ctypes.c_uint64,
+        ctypes.c_uint64,
+        ctypes.c_uint64,
+        ctypes.c_uint64,
+        ctypes.c_void_p,
+        ctypes.c_int,
+    ]
     _guppi_lib = lib
     return _guppi_lib
+
+
+def guppi_pread_strided(
+    path: str,
+    offset: int,
+    nchan: int,
+    chan_bytes: int,
+    src_stride: int,
+    dst,
+    dst_stride: int,
+    nthreads: int = 8,
+) -> None:
+    """Threaded strided read: channel ``c``'s bytes ``[offset +
+    c*src_stride, +chan_bytes)`` land at ``dst + c*dst_stride`` — the
+    zero-copy feed from a GUPPI block on disk into the streaming ring
+    buffer (blit/native/guppi.cc).  ``dst``: a C-contiguous ndarray whose
+    buffer the rows fit inside.  Raises ``OSError`` on failure;
+    ``RuntimeError`` if the library is unbuilt."""
+    lib = guppi_lib()
+    if lib is None:
+        raise RuntimeError("native GUPPI reader unbuilt: make -C blit/native")
+    try:  # numpy 2.x home, 1.x fallback
+        from numpy.lib.array_utils import byte_bounds
+    except ImportError:  # pragma: no cover
+        from numpy import byte_bounds
+    low, high = byte_bounds(dst)
+    base = dst.ctypes.data
+    if base < low or base + dst_stride * (nchan - 1) + chan_bytes > high:
+        raise ValueError("guppi_pread_strided: rows exceed dst buffer")
+    rc = lib.blit_guppi_pread2(
+        path.encode(), offset, nchan, chan_bytes, src_stride, dst_stride,
+        base, nthreads,
+    )
+    if rc:
+        import os as _os
+
+        raise OSError(-rc, _os.strerror(-rc), path)
 
 
 def guppi_pread(path: str, offset: int, size: int, nthreads: int = 8):

@@ -37,6 +37,55 @@ int pread_range(int fd, uint8_t* dst, uint64_t off, uint64_t len) {
 
 extern "C" {
 
+// Strided per-channel read: GUPPI blocks are channel-major on disk
+// ([chan][ntime][pol][2]), and the streaming pipeline appends each block at
+// a time offset inside a persistent (chan, cap, pol, 2) ring buffer — so
+// the destination rows are contiguous but strided per channel.  Reading
+// channel c's bytes [offset + c*src_stride, +chan_bytes) straight into
+// out + c*dst_stride lands the block in the ring with ZERO intermediate
+// copies (the drop-overlap trim and time-skip fall out of chan_bytes /
+// offset arithmetic).  Channels fan out round-robin across threads.
+int blit_guppi_pread2(const char* path, uint64_t offset, uint64_t nchan,
+                      uint64_t chan_bytes, uint64_t src_stride,
+                      uint64_t dst_stride, void* out, int nthreads) {
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return -errno;
+  if (nthreads < 1) nthreads = 1;
+  const uint64_t kMinPerThread = 4ull << 20;
+  uint64_t total = nchan * chan_bytes;
+  uint64_t want = (total + kMinPerThread - 1) / kMinPerThread;
+  if ((uint64_t)nthreads > want) nthreads = (int)want;
+  if ((uint64_t)nthreads > nchan) nthreads = (int)nchan;
+  if (nthreads <= 1) {
+    int rc = 0;
+    for (uint64_t c = 0; c < nchan && rc == 0; c++) {
+      rc = pread_range(fd, (uint8_t*)out + c * dst_stride,
+                       offset + c * src_stride, chan_bytes);
+    }
+    ::close(fd);
+    return rc;
+  }
+  std::vector<std::thread> threads;
+  std::vector<int> rcs(nthreads, 0);
+  for (int t = 0; t < nthreads; t++) {
+    threads.emplace_back([=, &rcs] {
+      for (uint64_t c = (uint64_t)t; c < nchan; c += (uint64_t)nthreads) {
+        int rc = pread_range(fd, (uint8_t*)out + c * dst_stride,
+                             offset + c * src_stride, chan_bytes);
+        if (rc) {
+          rcs[t] = rc;
+          return;
+        }
+      }
+    });
+  }
+  for (auto& th : threads) th.join();
+  ::close(fd);
+  for (int rc : rcs)
+    if (rc) return rc;
+  return 0;
+}
+
 int blit_guppi_pread(const char* path, uint64_t offset, uint64_t size,
                      void* out, int nthreads) {
   int fd = ::open(path, O_RDONLY);

@@ -153,12 +153,23 @@ def fft_planar(
     *,
     method: str = "auto",
     precision=None,
+    dtype: str = "float32",
 ) -> Tuple[jax.Array, jax.Array]:
     """Planar (re, im) FFT along the last axis — the dispatch point between
-    the complex-dtype XLA paths and the TPU matmul-DFT path."""
+    the complex-dtype XLA paths and the TPU matmul-DFT path.
+
+    ``dtype``: working dtype of the matmul-DFT stages ("float32" |
+    "bfloat16").  bf16 halves the HBM held by the inter-stage intermediates
+    — the lever that lets more frames fit per dispatch (DESIGN.md §3) — at
+    a measured spectral accuracy cost comparable to the MXU's default
+    bf16-grade multiplies (DESIGN.md §1).  Complex-FFT backends ignore it.
+    """
     method = resolve_fft_method(method, fr.shape[-1])
     if method == "matmul":
-        return dftmod.dft(fr, fi, precision=precision)
+        if dtype != "float32":
+            fr = fr.astype(dtype)
+            fi = fi.astype(dtype)
+        return dftmod.dft(fr, fi, precision=precision, dtype=dtype)
     z = fft(jax.lax.complex(fr, fi), method=method)
     return jnp.real(z), jnp.imag(z)
 
@@ -261,7 +272,7 @@ def integrate(power: jax.Array, nint: int) -> jax.Array:
     jax.jit,
     static_argnames=(
         "nfft", "ntap", "nint", "stokes", "fft_method", "precision",
-        "channel_block",
+        "channel_block", "dtype",
     ),
 )
 def channelize(
@@ -275,6 +286,7 @@ def channelize(
     fft_method: str = "auto",
     precision: Optional[str] = None,
     channel_block: int = 0,
+    dtype: str = "float32",
 ) -> jax.Array:
     """The full single-chip reduction: int8 voltage block → filterbank slab.
 
@@ -296,6 +308,12 @@ def channelize(
         of this size via ``lax.map`` *inside* one device program — large
         per-dispatch work (amortizing dispatch latency) at bounded peak HBM
         (the hi-res 1M-point intermediates are what overflow otherwise).
+      dtype: working dtype of the FFT stages ("float32" | "bfloat16").
+        bfloat16 halves the HBM the inter-stage spectra occupy, fitting ~2x
+        the frames per dispatch; dequantization/PFB stay float32 and the
+        detected powers accumulate in float32 (the cast happens at the DFT
+        boundary, where the MXU truncates to bf16-grade products by default
+        anyway).  Measured accuracy: see DESIGN.md §1/§8.
 
     Returns:
       float32 ``(ntime_out, nif, nchan_coarse*nfft)`` in blit's canonical
@@ -322,13 +340,22 @@ def channelize(
     )
     shifted_coeffs = coeffs * sign[None, :]
 
+    if dtype not in ("float32", "bfloat16"):
+        raise ValueError(f"dtype must be float32 or bfloat16, got {dtype!r}")
+
     def core(v):
         re, im = dequantize(v)  # (cb, ntime, npol) each
         re = jnp.moveaxis(re, -1, 1)  # (cb, npol, ntime)
         im = jnp.moveaxis(im, -1, 1)
         fr = pfb_frontend(re, shifted_coeffs)  # (cb, npol, nframes, nfft)
         fi = pfb_frontend(im, shifted_coeffs)
-        sr, si = fft_planar(fr, fi, method=fft_method, precision=prec)
+        sr, si = fft_planar(
+            fr, fi, method=fft_method, precision=prec, dtype=dtype
+        )
+        if sr.dtype != jnp.float32:
+            # Detect + integrate accumulate in f32 (the cast fuses into the
+            # detect kernel; only the DFT intermediates stay half-width).
+            sr, si = sr.astype(jnp.float32), si.astype(jnp.float32)
         power = detect_stokes_planar(sr, si, stokes)  # (cb, nif, frames, nfft)
         return integrate(power, nint)  # (cb, nif, ntime_out, nfft)
 

@@ -30,16 +30,31 @@ from blit.parallel import mesh as M
 log = logging.getLogger("blit.scan")
 
 
+def _kept_samples(raw: GuppiRaw) -> int:
+    """Gap-free samples the file yields — header arithmetic only (block
+    sizes and OVERLAP are in the scanned headers; no data read)."""
+    return sum(raw.block_ntime_kept(i) for i in range(raw.nblocks))
+
+
 def _gapless(raw: GuppiRaw, max_samples: Optional[int]) -> np.ndarray:
-    """Concatenate a RAW file's overlap-trimmed blocks up to max_samples."""
-    parts, total = [], 0
-    for _, blk in raw.iter_blocks(drop_overlap=True):
-        parts.append(blk)
-        total += blk.shape[1]
-        if max_samples is not None and total >= max_samples:
+    """A RAW file's overlap-trimmed voltages, read ONCE directly into the
+    final ``(nchan, total, npol, 2)`` buffer (native threaded pread per
+    block when built) — no per-block concatenation, no second pass."""
+    hdr = raw.header(0)
+    nchan = hdr["OBSNCHAN"]
+    npol = 2 if hdr["NPOL"] > 2 else hdr["NPOL"]
+    total = _kept_samples(raw)
+    if max_samples is not None:
+        total = min(total, max_samples)
+    out = np.empty((nchan, total, npol, 2), np.int8)
+    filled = 0
+    for i in range(raw.nblocks):
+        if filled >= total:
             break
-    v = np.concatenate(parts, axis=1)
-    return v[:, :max_samples] if max_samples is not None else v
+        nt = min(raw.block_ntime_kept(i), total - filled)
+        raw.read_block_into(i, out[:, filled:], t0=0, ntime_keep=nt)
+        filled += nt
+    return out
 
 
 def load_scan_mesh(
@@ -89,11 +104,8 @@ def load_scan_mesh(
 
     # Common whole-frame span across every player (ragged recordings trim),
     # via the same frame-accounting invariant the streaming pipeline uses.
-    min_samps = min(
-        sum(b.shape[1] for _, b in r.iter_blocks(drop_overlap=True))
-        for row in raws
-        for r in row
-    )
+    # Header arithmetic only — each file's data is read exactly once, below.
+    min_samps = min(_kept_samples(r) for row in raws for r in row)
     frames = usable_frames(min_samps, nfft, ntap, nint)
     if max_frames is not None:
         frames = min(frames, (max_frames // nint) * nint)

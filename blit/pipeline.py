@@ -131,37 +131,104 @@ class RawReducer:
         PFB window starts at sample ``N*nfft`` of the gap-free stream, so
         skipping that many samples reproduces the remaining frames
         bit-identically (the resume path of :meth:`reduce_resumable`).
+
+        Ingest buffering is a preallocated ring: each block is read (via the
+        native threaded pread when built — ``GuppiRaw.read_block_into``)
+        straight into the ring at its time offset, with no per-block
+        re-concatenation of the whole buffer; after each chunk the
+        ``(ntap-1)*nfft``-sample filter state plus any residue shifts down
+        in place.
         """
+        for chunk, frames in self._chunks(raw, skip_frames):
+            yield self._run_chunk(chunk)
+            self._output_frames += frames
+
+    def _chunks(
+        self, raw: GuppiRaw, skip_frames: int = 0
+    ) -> Iterator[Tuple[np.ndarray, int]]:
+        """The ring-buffered chunker behind :meth:`stream` / :meth:`drain`:
+        yields ``(chunk_view, frames)`` pairs.  The view aliases the ring and
+        is only valid until the next iteration."""
         nfft, ntap, nint = self.nfft, self.ntap, self.nint
         chunk_samps = (self.chunk_frames + ntap - 1) * nfft
         advance = self.chunk_frames * nfft
         to_skip = skip_frames * nfft
-        buf: Optional[np.ndarray] = None
+        ring: Optional[np.ndarray] = None
+        filled = 0
         with self.timeline.stage("stream"):
-            for _, block in raw.iter_blocks(drop_overlap=True):
-                if to_skip >= block.shape[1]:
-                    to_skip -= block.shape[1]
+            for i in range(raw.nblocks):
+                hdr = raw.header(i)
+                nt = raw.block_ntime_kept(i)
+                if to_skip >= nt:
+                    to_skip -= nt
                     continue
-                if to_skip:
-                    block = block[:, to_skip:]
-                    to_skip = 0
-                with self.timeline.stage("ingest", nbytes=block.nbytes):
-                    block = np.ascontiguousarray(block)
-                    buf = (
-                        block if buf is None
-                        else np.concatenate([buf, block], axis=1)
+                t0, nt = to_skip, nt - to_skip
+                to_skip = 0
+                nchan = hdr["OBSNCHAN"]
+                npol = 2 if hdr["NPOL"] > 2 else hdr["NPOL"]
+                with self.timeline.stage("ingest", nbytes=nchan * nt * npol * 2):
+                    if ring is None:
+                        cap = chunk_samps + nt
+                        ring = np.empty((nchan, cap, npol, 2), np.int8)
+                    elif filled + nt > ring.shape[1]:
+                        # Variable block sizes (rare): grow, preserving state.
+                        cap = max(2 * ring.shape[1], filled + nt)
+                        bigger = np.empty(
+                            (ring.shape[0], cap) + ring.shape[2:], np.int8
+                        )
+                        bigger[:, :filled] = ring[:, :filled]
+                        ring = bigger
+                    raw.read_block_into(
+                        i, ring[:, filled:], t0=t0, ntime_keep=nt
                     )
-                while buf.shape[1] >= chunk_samps:
-                    yield self._run_chunk(buf[:, :chunk_samps])
-                    self._output_frames += self.chunk_frames
-                    buf = buf[:, advance:]
-            if buf is not None:
+                    filled += nt
+                while filled >= chunk_samps:
+                    yield ring[:, :chunk_samps], self.chunk_frames
+                    filled -= advance
+                    # In-place shift of filter state + residue (numpy
+                    # guarantees overlapping same-array assignment copies
+                    # as-if through a temporary).
+                    ring[:, :filled] = ring[:, advance : advance + filled]
+            if ring is not None and filled > 0:
                 # Flush: whole frames remaining, rounded to the integration.
-                frames = usable_frames(buf.shape[1], nfft, ntap, nint)
+                frames = usable_frames(filled, nfft, ntap, nint)
                 if frames > 0:
-                    tail = buf[:, : (frames + ntap - 1) * nfft]
-                    yield self._run_chunk(tail)
-                    self._output_frames += frames
+                    yield ring[:, : (frames + ntap - 1) * nfft], frames
+
+    def drain(self, raw: GuppiRaw) -> float:
+        """Run the full streaming reduction with a device-side sink: each
+        chunk's product reduces to a scalar checksum on device and only the
+        final float crosses back.
+
+        Nothing synchronizes per chunk, so host block reads, host→device
+        transfers and device compute overlap through JAX's async dispatch —
+        this is the steady-state shape of the ingest path, and the
+        throughput probe for rigs whose device→host link is not
+        representative (e.g. the dev tunnel's ~10 MB/s readback,
+        DESIGN.md §8).  Returns the checksum (sum over all products).
+        """
+        import jax
+        import jax.numpy as jnp
+
+        sums = []
+        for chunk, frames in self._chunks(raw):
+            # The view aliases the ring, which mutates after this iteration;
+            # device_put's host-side read time is not guaranteed, so hand
+            # JAX a stable copy before the async dispatch.
+            stable = chunk.copy()
+            with self.timeline.stage("device", nbytes=stable.nbytes):
+                out = channelize(
+                    jax.numpy.asarray(stable),
+                    self._coeffs,
+                    nfft=self.nfft,
+                    ntap=self.ntap,
+                    nint=self.nint,
+                    stokes=self.stokes,
+                    fft_method=self.fft_method,
+                )
+                sums.append(jnp.sum(out))
+            self._output_frames += frames
+        return float(sum(float(s) for s in sums)) if sums else 0.0
 
     # -- whole-file conveniences ------------------------------------------
     def header_for(self, raw: GuppiRaw) -> Dict:

@@ -7,6 +7,18 @@ import pytest
 jax = pytest.importorskip("jax")
 import jax.numpy as jnp  # noqa: E402
 
+
+@pytest.fixture(autouse=True)
+def nan_guard():
+    """SURVEY.md §5 sanitizer plan: every golden run in this module executes
+    under jax_debug_nans, so a NaN produced anywhere in the reduction
+    (relevant with reduced-precision MXU paths) fails loudly here rather
+    than silently polluting products."""
+    jax.config.update("jax_debug_nans", True)
+    yield
+    jax.config.update("jax_debug_nans", False)
+
+
 from blit.ops import channelize as ch  # noqa: E402
 
 
@@ -134,6 +146,29 @@ class TestChannelize:
         np.testing.assert_allclose(a, b, rtol=1e-3, atol=10.0)
         rel = np.abs(a - b).max() / np.abs(a).max()
         assert rel < 1e-4
+
+    def test_bfloat16_stage_dtype_close_to_golden(self):
+        # dtype="bfloat16" halves the DFT intermediates' HBM (the
+        # frames-per-dispatch lever, DESIGN.md §8); detected powers stay
+        # within bf16-grade accuracy of the f64 NumPy golden.
+        nfft, ntap, nint = 256, 4, 2
+        v = make_voltages(
+            ntime=(ntap - 1 + 2 * nint) * nfft, nfft=nfft, tone=(1, 70)
+        )
+        h = ch.pfb_coeffs(ntap, nfft)
+        want = ch.channelize_np(v, h, nfft=nfft, ntap=ntap, nint=nint)
+        got = np.asarray(ch.channelize(
+            jnp.asarray(v), jnp.asarray(h), nfft=nfft, ntap=ntap, nint=nint,
+            fft_method="matmul", dtype="bfloat16",
+        ))
+        assert got.dtype == np.float32  # detect/integrate accumulate in f32
+        scale = want.max()
+        np.testing.assert_allclose(got / scale, want / scale, atol=2e-2)
+        # The tone must land in the same fine channel at full amplitude.
+        assert got[0, 0].argmax() == want[0, 0].argmax()
+        np.testing.assert_allclose(
+            got[0, 0].max(), want[0, 0].max(), rtol=1e-2
+        )
 
     def test_single_pol(self):
         v = make_voltages(nchan=2, ntime=5 * 32, npol=1)

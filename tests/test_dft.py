@@ -7,6 +7,18 @@ import pytest
 jax = pytest.importorskip("jax")
 import jax.numpy as jnp  # noqa: E402
 
+
+@pytest.fixture(autouse=True)
+def nan_guard():
+    """SURVEY.md §5 sanitizer plan: every golden run in this module executes
+    under jax_debug_nans, so a NaN produced anywhere in the reduction
+    (relevant with reduced-precision MXU paths) fails loudly here rather
+    than silently polluting products."""
+    jax.config.update("jax_debug_nans", True)
+    yield
+    jax.config.update("jax_debug_nans", False)
+
+
 from blit.ops import dft as D  # noqa: E402
 from blit.ops.channelize import channelize, fft_planar, pfb_coeffs  # noqa: E402
 

@@ -139,3 +139,72 @@ class TestGuppiPread:
         p.write_bytes(b"abc")
         with pytest.raises(OSError):
             guppi_pread(str(p), 0, 100)
+
+
+class TestNativeGuppiRaw:
+    """Parity of the native threaded reader against the memmap path."""
+
+    def _raw(self, tmp_path, **kw):
+        from blit.testing import synth_raw
+
+        p = str(tmp_path / "n.raw")
+        synth_raw(p, nblocks=3, obsnchan=4, ntime_per_block=256, **kw)
+        return p
+
+    def test_read_block_native_matches_memmap(self, tmp_path):
+        from blit.io.guppi import GuppiRaw
+        from blit.io.native import guppi_lib
+
+        if guppi_lib() is None:
+            pytest.skip("native reader unbuilt")
+        p = self._raw(tmp_path, directio=True)
+        a, b = GuppiRaw(p, native=True), GuppiRaw(p, native=False)
+        assert a.native and not b.native
+        for i in range(a.nblocks):
+            np.testing.assert_array_equal(a.read_block(i), b.read_block(i))
+
+    @pytest.mark.parametrize("native", [True, False])
+    def test_read_block_into_ring_slice(self, tmp_path, native):
+        from blit.io.guppi import GuppiRaw
+        from blit.io.native import guppi_lib
+
+        if native and guppi_lib() is None:
+            pytest.skip("native reader unbuilt")
+        p = self._raw(tmp_path, overlap=32)
+        raw = GuppiRaw(p, native=native)
+        want = raw.read_block(1)
+        # Land samples [16, 16+128) at time offset 40 of a wider ring.
+        ring = np.full((4, 512, 2, 2), -100, np.int8)
+        n = raw.read_block_into(1, ring[:, 40:], t0=16, ntime_keep=128)
+        assert n == 128
+        np.testing.assert_array_equal(ring[:, 40:168], want[:, 16:144])
+        assert (ring[:, :40] == -100).all() and (ring[:, 168:] == -100).all()
+
+    def test_read_block_into_bounds_checked(self, tmp_path):
+        from blit.io.guppi import GuppiRaw
+
+        p = self._raw(tmp_path)
+        raw = GuppiRaw(p)
+        ring = np.empty((4, 64, 2, 2), np.int8)
+        with pytest.raises(ValueError, match="outside block"):
+            raw.read_block_into(0, ring, t0=200, ntime_keep=100)
+        with pytest.raises(ValueError):
+            raw.read_block_into(0, np.empty((3, 64, 2, 2), np.int8))
+
+    @pytest.mark.parametrize("native", [True, False])
+    def test_stream_identical_across_readers(self, tmp_path, native):
+        pytest.importorskip("jax")
+        from blit.io.guppi import GuppiRaw
+        from blit.io.native import guppi_lib
+        from blit.pipeline import RawReducer
+
+        if native and guppi_lib() is None:
+            pytest.skip("native reader unbuilt")
+        p = self._raw(tmp_path, overlap=64, tone_chan=2)
+        red = RawReducer(nfft=32, nint=2, chunk_frames=4)
+        slabs = list(red.stream(GuppiRaw(p, native=native)))
+        red2 = RawReducer(nfft=32, nint=2, chunk_frames=4)
+        slabs2 = list(red2.stream(GuppiRaw(p, native=not native)))
+        assert len(slabs) == len(slabs2)
+        for s1, s2 in zip(slabs, slabs2):
+            np.testing.assert_array_equal(s1, s2)

@@ -45,6 +45,21 @@ class TestStreaming:
         rel = np.abs(data - want).max() / want.max()
         assert rel < 1e-4
 
+    @pytest.mark.parametrize("overlap", [0, 64])
+    def test_drain_checksum_matches_stream(self, tmp_path, overlap):
+        # The device-sink path must reduce exactly the frames the host-sink
+        # path yields (same chunker underneath).
+        p = str(tmp_path / "x.raw")
+        synth_raw(p, nblocks=4, obsnchan=4, ntime_per_block=1024 + overlap,
+                  overlap=overlap, tone_chan=1)
+        red = RawReducer(nfft=128, nint=2, chunk_frames=4)
+        slabs = list(red.stream(GuppiRaw(p)))
+        want = sum(float(s.sum()) for s in slabs)
+        red2 = RawReducer(nfft=128, nint=2, chunk_frames=4)
+        got = red2.drain(GuppiRaw(p))
+        assert red2.stats.output_frames == red.stats.output_frames
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
     def test_chunk_frames_rounds_to_nint(self):
         red = RawReducer(nfft=64, nint=6, chunk_frames=8)
         assert red.chunk_frames % 6 == 0
