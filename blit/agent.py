@@ -67,21 +67,37 @@ _SAFE_GLOBALS = frozenset({
     ("numpy", "amin"), ("numpy", "nansum"), ("numpy", "nanmean"),
     ("numpy", "std"), ("numpy", "var"),
     ("numpy", "nanmedian"), ("numpy", "nanmax"), ("numpy", "nanmin"),
-    # Compiled regex patterns (inventory filters).
-    ("re", "_compile"),
     # blit record types that legitimately cross the wire.
     ("blit.inventory", "InventoryRecord"),
     ("blit.naming", "GuppiName"),
     ("blit.config", "SiteConfig"),
 })
+
+# Requests additionally carry compiled regex patterns (inventory filters) —
+# ``re._compile`` is a pure pattern constructor, acceptable on the *request*
+# side where the caller already controls what the agent executes.  Responses
+# must not admit it: a compromised peer's reply could hand the client a
+# pathological pattern (ReDoS on next use), and no legitimate response needs
+# to construct one — results are arrays/records/dicts.
+_SAFE_GLOBALS_REQUEST = _SAFE_GLOBALS | {("re", "_compile")}
+_SAFE_GLOBALS_RESPONSE = _SAFE_GLOBALS
+
+# A peer claiming a frame beyond this is not merely oversized, it is hostile
+# or corrupt (the u64 header can claim up to 16 EiB); draining it would pin
+# the reader in a discard loop, so the stream is torn down instead.
+_DRAIN_CAP_MULTIPLE = 4
 _SAFE_BUILTINS = frozenset(
     {"slice", "complex", "range", "frozenset", "set", "bytearray"}
 )
 
 
 class _RestrictedUnpickler(pickle.Unpickler):
+    def __init__(self, file, safe_globals=_SAFE_GLOBALS_REQUEST):
+        super().__init__(file)
+        self._safe_globals = safe_globals
+
     def find_class(self, module: str, name: str):
-        if (module, name) in _SAFE_GLOBALS:
+        if (module, name) in self._safe_globals:
             return super().find_class(module, name)
         if module == "builtins" and name in _SAFE_BUILTINS:
             return super().find_class(module, name)
@@ -101,14 +117,31 @@ def resolve(fn_path: str):
     return fn
 
 
-def read_msg(stream, max_bytes: int = 0) -> object:
+def read_msg(
+    stream,
+    max_bytes: int = 0,
+    safe_globals=_SAFE_GLOBALS_REQUEST,
+    drain_oversized: bool = True,
+) -> object:
     """Read one framed message.  The length header is untrusted: it is
     validated against ``max_bytes`` (default :data:`MAX_MSG_BYTES`) before
     any buffer is allocated.
 
-    On an oversized header the body is consumed in bounded chunks and
-    discarded before :class:`pickle.UnpicklingError` is raised, so the
-    stream stays framed and the peer can keep servicing requests.
+    On a modestly oversized header the body is consumed in bounded chunks
+    and discarded before :class:`pickle.UnpicklingError` is raised, so the
+    stream stays framed and the peer can keep servicing requests.  A claim
+    beyond ``_DRAIN_CAP_MULTIPLE`` times the limit is treated as a dead or
+    hostile stream — :class:`EOFError` tears the connection down rather than
+    letting a 2^64-byte claim pin the reader in a discard loop.
+
+    ``safe_globals`` picks the unpickling allow-list for the direction:
+    requests admit compiled regexes (:data:`_SAFE_GLOBALS_REQUEST`, the
+    default), responses do not (:data:`_SAFE_GLOBALS_RESPONSE`).
+
+    ``drain_oversized=False`` skips the keep-the-stream-framed drain and
+    refuses an oversized frame immediately — for callers who tear the
+    connection down on refusal anyway (the client's response path), where
+    draining a multi-GiB body through an ssh pipe would be pure waste.
     """
     head = stream.read(_LEN.size)
     if len(head) < _LEN.size:
@@ -116,6 +149,16 @@ def read_msg(stream, max_bytes: int = 0) -> object:
     (n,) = _LEN.unpack(head)
     limit = max_bytes or MAX_MSG_BYTES
     if n > limit:
+        if not drain_oversized:
+            raise pickle.UnpicklingError(
+                f"agent wire message of {n} bytes exceeds the {limit}-byte "
+                "limit (stream not drained; tear down the connection)"
+            )
+        if n > _DRAIN_CAP_MULTIPLE * limit:
+            raise EOFError(
+                f"agent wire claims a {n}-byte frame (> {_DRAIN_CAP_MULTIPLE}x "
+                f"the {limit}-byte limit); tearing down the stream"
+            )
         remaining = n
         while remaining > 0:
             chunk = stream.read(min(remaining, 1 << 20))
@@ -129,7 +172,19 @@ def read_msg(stream, max_bytes: int = 0) -> object:
     body = stream.read(n)
     if len(body) < n:
         raise EOFError
-    return _RestrictedUnpickler(io.BytesIO(body)).load()
+    # The frame is fully consumed: any decode failure past this point
+    # (truncated pickle → EOFError, UnicodeDecodeError, struct.error, an
+    # allow-listed global missing in this numpy version → AttributeError...)
+    # leaves the stream correctly framed, so it is reported as a refusal the
+    # peer can recover from — never confused with stream-level EOF.
+    try:
+        return _RestrictedUnpickler(io.BytesIO(body), safe_globals).load()
+    except pickle.UnpicklingError:
+        raise
+    except Exception as e:
+        raise pickle.UnpicklingError(
+            f"agent wire body failed to decode: {type(e).__name__}: {e}"
+        ) from e
 
 
 def write_msg(stream, obj) -> None:
@@ -145,14 +200,24 @@ def serve(stdin=None, stdout=None) -> None:
     stdout = stdout or sys.stdout.buffer
     while True:
         try:
-            fn_path, args, kwargs = read_msg(stdin)
-        except EOFError:
+            msg = read_msg(stdin)
+        except (EOFError, OSError):
+            # Stream-level trouble (EOF, hostile length claim, dropped
+            # pipe/pty): the connection is gone or unframed — end the loop
+            # rather than spin err frames into a dead stream.
             return
         except pickle.UnpicklingError as e:
-            # A refused request (oversized or disallowed global) must not
-            # kill the worker: the stream is still framed (read_msg consumed
-            # the body), so report the refusal and keep serving.
+            # A refused or malformed request must not kill the worker:
+            # read_msg consumed the framed body (and translates every
+            # decode failure to UnpicklingError), so the stream is still
+            # framed — report the refusal and keep serving.
             write_msg(stdout, ("err", "UnpicklingError", str(e), ""))
+            continue
+        try:
+            fn_path, args, kwargs = msg
+        except (TypeError, ValueError) as e:
+            # Decoded fine but not the (fn_path, args, kwargs) shape.
+            write_msg(stdout, ("err", type(e).__name__, str(e), ""))
             continue
         try:
             result = resolve(fn_path)(*args, **kwargs)

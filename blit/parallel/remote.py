@@ -23,7 +23,7 @@ import sys
 import threading
 from typing import Callable, List, Optional, Sequence
 
-from blit.agent import MAGIC, read_msg, write_msg
+from blit.agent import MAGIC, _SAFE_GLOBALS_RESPONSE, read_msg, write_msg
 
 log = logging.getLogger("blit.remote")
 
@@ -122,7 +122,17 @@ class RemoteWorker:
             proc = self._ensure()
             try:
                 write_msg(proc.stdin, (fn_path, args, kwargs))
-                reply = read_msg(proc.stdout)
+                # Responses get the narrower allow-list: no ``re._compile``
+                # (a compromised peer must not hand the client a pathological
+                # regex; results are arrays/records/dicts only).  No drain on
+                # oversize either — the refusal below kills the worker, so
+                # pulling a multi-GiB body through the ssh pipe first would
+                # be pure waste.
+                reply = read_msg(
+                    proc.stdout,
+                    safe_globals=_SAFE_GLOBALS_RESPONSE,
+                    drain_oversized=False,
+                )
             except (BrokenPipeError, EOFError) as e:
                 try:
                     rc = proc.wait(timeout=5)  # reap; no zombie

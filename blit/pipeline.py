@@ -32,7 +32,7 @@ from typing import Dict, Iterator, Optional, Tuple
 import numpy as np
 
 from blit.io.guppi import GuppiRaw
-from blit.observability import Timeline
+from blit.observability import Timeline, profile_trace
 from blit.ops.channelize import (
     STOKES_NIF,
     channelize,
@@ -78,6 +78,9 @@ class RawReducer:
     chunk_frames: Optional[int] = None
     # Per-stage timing/byte registry ("ingest" / "device" / "stream").
     timeline: Timeline = field(default_factory=Timeline)
+    # When set, a JAX profiler trace (TensorBoard/Perfetto readable) wraps
+    # every streaming run — SURVEY.md §5 "traces around ingest + kernels".
+    trace_logdir: Optional[str] = None
 
     def __post_init__(self):
         import jax.numpy as jnp
@@ -139,9 +142,10 @@ class RawReducer:
         ``(ntap-1)*nfft``-sample filter state plus any residue shifts down
         in place.
         """
-        for chunk, frames in self._chunks(raw, skip_frames):
-            yield self._run_chunk(chunk)
-            self._output_frames += frames
+        with profile_trace(self.trace_logdir):
+            for chunk, frames in self._chunks(raw, skip_frames):
+                yield self._run_chunk(chunk)
+                self._output_frames += frames
 
     def _chunks(
         self, raw: GuppiRaw, skip_frames: int = 0
@@ -210,25 +214,30 @@ class RawReducer:
         import jax
         import jax.numpy as jnp
 
-        sums = []
-        for chunk, frames in self._chunks(raw):
-            # The view aliases the ring, which mutates after this iteration;
-            # device_put's host-side read time is not guaranteed, so hand
-            # JAX a stable copy before the async dispatch.
-            stable = chunk.copy()
-            with self.timeline.stage("device", nbytes=stable.nbytes):
-                out = channelize(
-                    jax.numpy.asarray(stable),
-                    self._coeffs,
-                    nfft=self.nfft,
-                    ntap=self.ntap,
-                    nint=self.nint,
-                    stokes=self.stokes,
-                    fft_method=self.fft_method,
-                )
-                sums.append(jnp.sum(out))
-            self._output_frames += frames
-        return float(sum(float(s) for s in sums)) if sums else 0.0
+        # The final float() sync must happen INSIDE the trace context, or
+        # the profiler stops before the queued tail of the async work it
+        # exists to capture.
+        with profile_trace(self.trace_logdir):
+            sums = []
+            for chunk, frames in self._chunks(raw):
+                # The view aliases the ring, which mutates after this
+                # iteration; device_put's host-side read time is not
+                # guaranteed, so hand JAX a stable copy before the async
+                # dispatch.
+                stable = chunk.copy()
+                with self.timeline.stage("device", nbytes=stable.nbytes):
+                    out = channelize(
+                        jax.numpy.asarray(stable),
+                        self._coeffs,
+                        nfft=self.nfft,
+                        ntap=self.ntap,
+                        nint=self.nint,
+                        stokes=self.stokes,
+                        fft_method=self.fft_method,
+                    )
+                    sums.append(jnp.sum(out))
+                self._output_frames += frames
+            return float(sum(float(s) for s in sums)) if sums else 0.0
 
     # -- whole-file conveniences ------------------------------------------
     def header_for(self, raw: GuppiRaw) -> Dict:

@@ -250,20 +250,73 @@ class TestHardening:
 
     def test_oversized_length_header_rejected_before_allocation(self):
         # A lying u64 header must not trigger a giant allocation: the cap
-        # check runs before the body read.
+        # check runs before the body read.  Modestly oversized (within the
+        # drain cap) → drained + UnpicklingError, stream stays framed.
         import pickle
 
         from blit.agent import read_msg, _LEN
 
-        stream = io.BytesIO(_LEN.pack(1 << 62))
+        stream = io.BytesIO(_LEN.pack(3 << 10) + b"x" * (3 << 10))
         with pytest.raises(pickle.UnpicklingError, match="exceeds"):
-            read_msg(stream)
+            read_msg(stream, max_bytes=1 << 10)
+        assert stream.read() == b""  # body fully drained: framing intact
         # Within an explicit cap: frames normally.
-        import pickle as pkl
-
-        body = pkl.dumps([1, 2, 3])
+        body = pickle.dumps([1, 2, 3])
         stream = io.BytesIO(_LEN.pack(len(body)) + body)
         assert read_msg(stream, max_bytes=1 << 20) == [1, 2, 3]
+
+    def test_absurd_length_claim_tears_down_stream(self):
+        # A claim beyond the drain cap (a u64 can say 2^62) must NOT pin the
+        # reader in a discard loop — EOFError ends the connection instead.
+        from blit.agent import read_msg, _LEN
+
+        stream = io.BytesIO(_LEN.pack(1 << 62))
+        with pytest.raises(EOFError, match="tearing down"):
+            read_msg(stream)
+
+    def test_response_allowlist_refuses_compiled_regex(self):
+        # Responses must not admit re._compile: a compromised peer's reply
+        # could hand the client a pathological (ReDoS) pattern.  Requests
+        # keep it (inventory filters legitimately carry regexes).
+        import pickle
+        import re as re_mod
+
+        from blit.agent import (
+            _SAFE_GLOBALS_RESPONSE, read_msg, write_msg,
+        )
+
+        buf = io.BytesIO()
+        write_msg(buf, re_mod.compile(r"0002\.h5$"))
+        buf.seek(0)
+        with pytest.raises(pickle.UnpicklingError, match="re._compile"):
+            read_msg(buf, safe_globals=_SAFE_GLOBALS_RESPONSE)
+        buf.seek(0)
+        assert read_msg(buf).pattern == r"0002\.h5$"  # request side: fine
+
+    def test_serve_survives_malformed_body(self):
+        # Garbage that fails inside pickle.loads with something OTHER than
+        # UnpicklingError (here: truncated pickle → EOF inside loads, and a
+        # non-tuple payload → unpack error) must produce err frames, not
+        # kill the loop — the stream is still framed after each.
+        import pickle
+
+        from blit.agent import _LEN, read_msg, serve, write_msg
+
+        inbuf = io.BytesIO()
+        bad = pickle.dumps((1, 2, 3, 4))[:-5]  # truncated mid-stream
+        inbuf.write(_LEN.pack(len(bad)) + bad)
+        inbuf.write(_LEN.pack(0))  # framed but EMPTY body (loads → EOFError)
+        write_msg(inbuf, "not a 3-tuple")
+        write_msg(inbuf, ("blit.ops.fqav.fqav_range", (1.0, 1.0, 4, 4), {}))
+        inbuf.seek(0)
+        out = io.BytesIO()
+        serve(inbuf, out)
+        out.seek(0)
+        assert read_msg(out)[0] == "err"
+        assert read_msg(out)[0] == "err"
+        assert read_msg(out)[0] == "err"
+        tag, result = read_msg(out)
+        assert tag == "ok" and result == (2.5, 4.0, 1)
 
     def test_fqav_reducers_cross_the_wire(self):
         # np.mean / np.sum are the documented fqav_func values; they must
