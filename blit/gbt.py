@@ -20,7 +20,7 @@ import numpy as np
 
 from blit import workers as wf
 from blit.config import DEFAULT, SiteConfig, datahosts  # noqa: F401 (re-export)
-from blit.inventory import InventoryRecord, to_dataframe  # noqa: F401
+from blit.inventory import InventoryRecord, raw_sequences, to_dataframe  # noqa: F401
 from blit.ops.despike import despike as _despike
 from blit.ops.fqav import fqav_range
 from blit.parallel.pool import (  # noqa: F401 (re-export)
@@ -208,7 +208,7 @@ def load_scan(
 
 def reduce_raw(
     worker_ids: Sequence[int],
-    raw_paths: Sequence[str],
+    raw_paths: Sequence[Union[str, Sequence[str]]],
     out_paths: Optional[Sequence[str]] = None,
     *,
     pool: Optional[WorkerPool] = None,
@@ -216,12 +216,16 @@ def reduce_raw(
     **reducer_kw,
 ) -> List:
     """Fan GUPPI RAW → filterbank reduction out over the workers that own
-    the files, one (worker, raw file) pair at a time — the distributed
+    the files, one (worker, raw source) pair at a time — the distributed
     rawspec replacement (capability extension over the reference, which
     only reads already-reduced products; BASELINE.json configs 1-2).
 
-    ``reducer_kw`` passes through to :func:`blit.workers.reduce_raw`
-    (``product=`` preset or ``nfft``/``nint``/``stokes``).
+    Each entry of ``raw_paths`` may be a single file path, a ``.NNNN.raw``
+    sequence stem, or a path list (one scan's multi-file recording —
+    :func:`blit.inventory.raw_sequences` groups an inventory into exactly
+    these units).  ``reducer_kw`` passes through to
+    :func:`blit.workers.reduce_raw` (``product=`` preset or
+    ``nfft``/``nint``/``stokes``).
     """
     if len(worker_ids) != len(raw_paths):
         raise ValueError("worker_ids and raw_paths must have the same size")

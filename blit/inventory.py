@@ -15,7 +15,7 @@ import json
 import logging
 import os
 import socket
-from typing import Iterable, List, NamedTuple, Optional, Pattern, Union
+from typing import Iterable, List, NamedTuple, Optional, Pattern, Tuple, Union
 
 from blit import naming
 from blit.config import DEFAULT, SiteConfig, _compile
@@ -123,6 +123,34 @@ def get_inventory(
                         )
                     )
     return records
+
+
+def raw_sequences(
+    records: Iterable[InventoryRecord],
+) -> List[Tuple[InventoryRecord, List[str]]]:
+    """Group RAW-file inventory records into ``.NNNN.raw`` scan sequences.
+
+    A GBT scan is recorded as ``<stem>.0000.raw, <stem>.0001.raw, ...``
+    (the NNNN field of the reference's filename grammar,
+    src/gbtworkerfunctions.jl:35-47; README.md:25-27) — one logical unit
+    the reducer must consume as a single gap-free stream
+    (blit/io/guppi.GuppiScan).  Returns ``(first_record, sorted_paths)``
+    per sequence, stem-sorted; records whose ``file`` is not a ``.NNNN.raw``
+    member are ignored.
+    """
+    from blit.io.guppi import SEQ_RE
+
+    groups: dict = {}
+    for r in records:
+        m = SEQ_RE.match(r.file)
+        if m is None:
+            continue
+        groups.setdefault(m.group("stem"), []).append((int(m.group("seq")), r))
+    out = []
+    for stem in sorted(groups):
+        members = sorted(groups[stem], key=lambda t: t[0])
+        out.append((members[0][1], [r.file for _, r in members]))
+    return out
 
 
 def to_dataframe(inventories: Iterable[Iterable[InventoryRecord]]):

@@ -7,7 +7,14 @@ with optional C++ acceleration from ``blit/native``.
 
 from blit.io.sigproc import read_fil_header, read_fil_data, write_fil
 from blit.io.fbh5 import is_hdf5, read_fbh5_header, read_fbh5_data, write_fbh5
-from blit.io.guppi import GuppiRaw, read_raw_header, write_raw
+from blit.io.guppi import (
+    GuppiRaw,
+    GuppiScan,
+    open_raw,
+    read_raw_header,
+    scan_files,
+    write_raw,
+)
 
 __all__ = [
     "read_fil_header",
@@ -18,6 +25,9 @@ __all__ = [
     "read_fbh5_data",
     "write_fbh5",
     "GuppiRaw",
+    "GuppiScan",
+    "open_raw",
+    "scan_files",
     "read_raw_header",
     "write_raw",
 ]

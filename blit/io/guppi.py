@@ -17,13 +17,26 @@ SURVEY.md §7 "hard parts").
 
 from __future__ import annotations
 
+import glob
+import logging
 import os
-from typing import Dict, Iterator, List, Optional, Tuple
+import re
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
+log = logging.getLogger("blit.guppi")
+
 CARD_LEN = 80
 DIRECTIO_ALIGN = 512
+
+# A scan is recorded as a *sequence* of files sharing a stem:
+#   guppi_<imjd>_<smjd>_[n_]<src>_<scan>.0000.raw, .0001.raw, ...
+# — the NNNN in the reference's filename grammar
+# (src/gbtworkerfunctions.jl:35-47; README.md:25-27).  The block stream
+# continues across file boundaries (same OVERLAP convention), so a whole
+# scan must be reduced as one gap-free stream (rawspec parity).
+SEQ_RE = re.compile(r"^(?P<stem>.+)\.(?P<seq>\d{4})\.raw$")
 
 
 def _parse_card_value(raw: str):
@@ -92,7 +105,47 @@ def block_ntime(hdr: Dict) -> int:
     return hdr["BLOCSIZE"] // bytes_per_samp
 
 
-class GuppiRaw:
+class _BlockStream:
+    """Shared gap-free-stream semantics over an indexed block sequence.
+
+    Subclasses provide ``nblocks``, ``header(i)`` and ``read_block(i)``; this
+    base owns the one overlap-trim rule (every block but the stream's last
+    drops its trailing ``OVERLAP`` samples — they repeat at the start of the
+    next block, whether or not a file boundary intervenes).
+    """
+
+    def block_ntime_kept(self, i: int) -> int:
+        """Time samples block ``i`` contributes to the gap-free stream."""
+        hdr = self.header(i)
+        nt = block_ntime(hdr)
+        if i < self.nblocks - 1:
+            nt -= hdr.get("OVERLAP", 0)
+        return nt
+
+    def iter_blocks(
+        self, drop_overlap: bool = False
+    ) -> Iterator[Tuple[Dict, np.ndarray]]:
+        """Yield ``(header, block)`` pairs; ``drop_overlap=True`` trims the
+        trailing ``OVERLAP`` samples of every block except the last, giving a
+        gap-free concatenation along time."""
+        for i in range(self.nblocks):
+            hdr = self.header(i)
+            block = self.read_block(i)
+            if drop_overlap and i < self.nblocks - 1:
+                ov = hdr.get("OVERLAP", 0)
+                if ov:
+                    block = block[:, :-ov]
+            yield hdr, block
+
+    def time_span_s(self) -> float:
+        """Total (overlap-corrected) duration covered by the stream."""
+        if not self.nblocks:
+            return 0.0
+        tbin = self.header(0).get("TBIN", 0.0)
+        return sum(self.block_ntime_kept(i) for i in range(self.nblocks)) * tbin
+
+
+class GuppiRaw(_BlockStream):
     """One GUPPI RAW file: indexed access to (header, voltage-block) pairs.
 
     Scans block boundaries once at construction (headers only — cheap), then
@@ -221,48 +274,176 @@ class GuppiRaw:
         dst[:, :ntime_keep] = mm[:, t0 : t0 + ntime_keep]
         return ntime_keep
 
-    def block_ntime_kept(self, i: int) -> int:
-        """Time samples block ``i`` contributes to the gap-free stream: its
-        trailing ``OVERLAP`` samples repeat at the start of the next block,
-        so every block but the last drops them."""
-        hdr = self.headers[i]
-        nt = block_ntime(hdr)
-        if i < self.nblocks - 1:
-            nt -= hdr.get("OVERLAP", 0)
-        return nt
-
     def read_block_complex(self, i: int) -> np.ndarray:
         """Block ``i`` as complex64, shaped ``(obsnchan, ntime, npol)``."""
         b = self.read_block(i).astype(np.float32)
         return (b[..., 0] + 1j * b[..., 1]).astype(np.complex64)
 
-    def iter_blocks(
-        self, drop_overlap: bool = False
-    ) -> Iterator[Tuple[Dict, np.ndarray]]:
-        """Yield ``(header, block)`` pairs; ``drop_overlap=True`` trims the
-        trailing ``OVERLAP`` samples of every block except the last, giving a
-        gap-free concatenation along time."""
-        for i in range(self.nblocks):
-            hdr = self.headers[i]
-            block = self.read_block(i)
-            if drop_overlap and i < self.nblocks - 1:
-                ov = hdr.get("OVERLAP", 0)
-                if ov:
-                    block = block[:, :-ov]
-            yield hdr, block
 
-    def time_span_s(self) -> float:
-        """Total (overlap-corrected) duration covered by the file."""
-        if not self.headers:
-            return 0.0
-        tbin = self.headers[0].get("TBIN", 0.0)
-        total = 0
-        for i, hdr in enumerate(self.headers):
-            nt = block_ntime(hdr)
-            if i < self.nblocks - 1:
-                nt -= hdr.get("OVERLAP", 0)
-            total += nt
-        return total * tbin
+def scan_files(stem_or_path: str) -> List[str]:
+    """Expand one member (or the bare stem) of a ``.NNNN.raw`` sequence into
+    the full sorted sequence present on disk.
+
+    ``"x.0001.raw"`` and ``"x"`` both yield ``["x.0000.raw", "x.0001.raw",
+    ...]``.  NNNN is zero-padded, so lexical sort is numeric sort.  Returns
+    ``[]`` when nothing matches.
+    """
+    m = SEQ_RE.match(stem_or_path)
+    stem = m.group("stem") if m else stem_or_path
+    return sorted(glob.glob(glob.escape(stem) + ".[0-9][0-9][0-9][0-9].raw"))
+
+
+class GuppiScan(_BlockStream):
+    """A multi-file GUPPI RAW scan sequence as one gap-free block stream.
+
+    Presents the same indexed-block API as :class:`GuppiRaw` (``nblocks``,
+    ``header``, ``read_block_into`` ...), with the file boundaries erased:
+    the trailing ``OVERLAP`` samples of the last block of every file but the
+    final one repeat at the start of the next file, exactly as they do
+    between blocks within a file, so ``block_ntime_kept`` trims them — the
+    streaming reducer's PFB state then carries across files for free.
+
+    rawspec (the tool being replaced) always consumes the whole sequence;
+    the reference's grammar records the NNNN field but its RAW path stops at
+    inventory (src/gbtworkerfunctions.jl:35-47).
+
+    ``strict=True`` turns sequence-consistency findings (missing NNNN in the
+    stem sequence, PKTIDX discontinuity or non-monotonicity at a file
+    boundary — all meaning dropped samples) into errors.  The exact
+    continuity check needs the per-block packet stride, learned from
+    within-file deltas; when no unambiguous stride exists (single-block
+    files, mixed block sizes) the boundary check degrades to
+    strictly-increasing PKTIDX.
+    """
+
+    def __init__(
+        self,
+        paths: Sequence[str],
+        native: Optional[bool] = None,
+        strict: bool = False,
+    ):
+        if not paths:
+            raise ValueError("GuppiScan: empty path sequence")
+        self.paths = list(paths)
+        self.files = [GuppiRaw(p, native=native) for p in self.paths]
+        empties = [f.path for f in self.files if f.nblocks == 0]
+        if empties:
+            raise ValueError(f"empty or fully truncated RAW file(s): {empties}")
+        self.path = self.paths[0]  # logging/error identity
+        self.native = self.files[0].native
+        # Flattened (file, local block) index.
+        self._blocks: List[Tuple[int, int]] = [
+            (fi, bi)
+            for fi, f in enumerate(self.files)
+            for bi in range(f.nblocks)
+        ]
+        self._check_sequence(strict)
+        # Geometry must agree across files (one recording, one config).
+        g0 = self.files[0]._block_geometry(0)
+        for f in self.files[1:]:
+            g = f._block_geometry(0)
+            if (g[0], g[2]) != (g0[0], g0[2]):
+                raise ValueError(
+                    f"{f.path}: (nchan, npol)={g[0], g[2]} disagrees with "
+                    f"{self.path}'s {g0[0], g0[2]}"
+                )
+
+    def _check_sequence(self, strict: bool) -> None:
+        problems = []
+        # Stem / NNNN continuity (when the names follow the grammar).
+        parsed = [SEQ_RE.match(p) for p in self.paths]
+        if all(parsed) and len({m.group("stem") for m in parsed}) == 1:
+            seqs = [int(m.group("seq")) for m in parsed]
+            if seqs != sorted(seqs):
+                problems.append(f"sequence numbers out of order: {seqs}")
+            missing = sorted(set(range(seqs[0], seqs[-1] + 1)) - set(seqs))
+            if missing:
+                problems.append(f"missing sequence numbers: {missing}")
+        # PKTIDX continuity across file boundaries: within-file block deltas
+        # establish the per-block packet stride; a different stride at a
+        # boundary means dropped blocks (a gap the PFB must not integrate
+        # across).  Real PKTIDX counts packets, not samples, so the stride is
+        # learned from the data rather than derived from headers.  With no
+        # unambiguous stride (single-block files, mixed block sizes) the
+        # check degrades to strictly-increasing — weaker, but never silently
+        # skipped.
+        strides = set()
+        for f in self.files:
+            idxs = [h.get("PKTIDX") for h in f.headers]
+            for a, b in zip(idxs, idxs[1:]):
+                if a is not None and b is not None:
+                    strides.add(b - a)
+        stride = strides.pop() if len(strides) == 1 else None
+        for k in range(len(self.files) - 1):
+            last = self.files[k].headers[-1].get("PKTIDX")
+            first = self.files[k + 1].headers[0].get("PKTIDX")
+            if last is None or first is None:
+                continue
+            if stride is not None and first - last != stride:
+                problems.append(
+                    f"PKTIDX gap at {self.paths[k + 1]}: expected "
+                    f"{last + stride}, got {first}"
+                )
+            elif stride is None and first <= last:
+                problems.append(
+                    f"PKTIDX not increasing at {self.paths[k + 1]}: "
+                    f"{last} -> {first}"
+                )
+        for p in problems:
+            if strict:
+                raise ValueError(f"GuppiScan: {p}")
+            log.warning("GuppiScan: %s", p)
+
+    @property
+    def nblocks(self) -> int:
+        return len(self._blocks)
+
+    def header(self, i: int = 0) -> Dict:
+        fi, bi = self._blocks[i]
+        return self.files[fi].headers[bi]
+
+    def _block_geometry(self, i: int) -> Tuple[int, int, int]:
+        fi, bi = self._blocks[i]
+        return self.files[fi]._block_geometry(bi)
+
+    def read_block(self, i: int) -> np.ndarray:
+        fi, bi = self._blocks[i]
+        return self.files[fi].read_block(bi)
+
+    def read_block_into(
+        self, i: int, dst: np.ndarray, t0: int = 0, ntime_keep: int = -1
+    ) -> int:
+        fi, bi = self._blocks[i]
+        return self.files[fi].read_block_into(bi, dst, t0=t0, ntime_keep=ntime_keep)
+
+    def read_block_complex(self, i: int) -> np.ndarray:
+        fi, bi = self._blocks[i]
+        return self.files[fi].read_block_complex(bi)
+
+
+RawSource = Union[str, Sequence[str], GuppiRaw, GuppiScan]
+
+
+def open_raw(src: RawSource, native: Optional[bool] = None):
+    """Open a RAW source as a block stream: a :class:`GuppiRaw` /
+    :class:`GuppiScan` passes through; a path list becomes a scan; a single
+    path opens that file; a *stem* (no such file on disk, but
+    ``<stem>.NNNN.raw`` members exist) expands to the whole sequence.
+    """
+    if isinstance(src, (GuppiRaw, GuppiScan)):
+        return src
+    if isinstance(src, (list, tuple)):
+        if len(src) == 1:
+            return GuppiRaw(src[0], native=native)
+        return GuppiScan(src, native=native)
+    if os.path.exists(src):
+        return GuppiRaw(src, native=native)
+    seq = scan_files(src)
+    if not seq:
+        raise FileNotFoundError(f"no RAW file or .NNNN.raw sequence at {src!r}")
+    if len(seq) == 1:
+        return GuppiRaw(seq[0], native=native)
+    return GuppiScan(seq, native=native)
 
 
 def write_raw(

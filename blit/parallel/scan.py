@@ -18,7 +18,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from blit.io.guppi import GuppiRaw
+from blit.io.guppi import GuppiRaw, open_raw
 from blit.ops.channelize import (
     STOKES_NIF,
     output_header,
@@ -73,9 +73,12 @@ def load_scan_mesh(
     """Reduce one scan's RAW files across the mesh and stitch each band.
 
     Args:
-      raw_paths: ``raw_paths[band][bank]`` — one RAW file per player, all
+      raw_paths: ``raw_paths[band][bank]`` — one RAW source per player, all
         covering the same scan (bank-ascending within each band, as the
-        inventory's (band, bank) sort yields them).
+        inventory's (band, bank) sort yields them).  Each source may be a
+        single file path, a ``.NNNN.raw`` sequence stem, or a path list
+        (blit/io/guppi.open_raw): a whole multi-file recording streams as
+        one gap-free span per player.
       max_frames: cap the PFB frames reduced (bounds HBM for long scans);
         None reduces the longest common whole-frame span.
       mesh: an existing ``(band, bank)`` Mesh; None builds one matching
@@ -96,7 +99,7 @@ def load_scan_mesh(
     if mesh is None:
         mesh = M.make_mesh(nband, nbank)
 
-    raws = [[GuppiRaw(p) for p in row] for row in raw_paths]
+    raws = [[open_raw(p) for p in row] for row in raw_paths]
     for row in raws:
         for r in row:
             if r.nblocks == 0:

@@ -27,11 +27,11 @@ from __future__ import annotations
 import logging
 import os
 from dataclasses import dataclass, field
-from typing import Dict, Iterator, Optional, Tuple
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
-from blit.io.guppi import GuppiRaw
+from blit.io.guppi import GuppiRaw, RawSource, open_raw
 from blit.observability import Timeline, profile_trace
 from blit.ops.channelize import (
     STOKES_NIF,
@@ -245,12 +245,13 @@ class RawReducer:
             raw.header(0), nfft=self.nfft, nint=self.nint, stokes=self.stokes
         )
 
-    def reduce(self, raw_path: str) -> Tuple[Dict, np.ndarray]:
-        """Reduce a whole RAW file in memory → ``(filterbank_header, data)``
-        with data shaped ``(nsamps, nif, nchans)``."""
-        raw = GuppiRaw(raw_path)
+    def reduce(self, raw_src: RawSource) -> Tuple[Dict, np.ndarray]:
+        """Reduce a whole RAW file — or a whole multi-file ``.NNNN.raw``
+        scan sequence (path list / stem, blit/io/guppi.open_raw) — in memory
+        → ``(filterbank_header, data)`` with data ``(nsamps, nif, nchans)``."""
+        raw = open_raw(raw_src)
         if raw.nblocks == 0:
-            raise ValueError(f"empty or fully truncated RAW file: {raw_path}")
+            raise ValueError(f"empty or fully truncated RAW file: {raw.path}")
         slabs = list(self.stream(raw))
         if slabs:
             data = np.concatenate(slabs, axis=0)
@@ -261,9 +262,9 @@ class RawReducer:
         hdr["nsamps"] = data.shape[0]
         return hdr, data
 
-    def reduce_to_file(self, raw_path: str, out_path: str) -> Dict:
+    def reduce_to_file(self, raw_src: RawSource, out_path: str) -> Dict:
         """Reduce and write a ``.fil`` or (``.h5``) FBH5 product."""
-        hdr, data = self.reduce(raw_path)
+        hdr, data = self.reduce(raw_src)
         if out_path.endswith((".h5", ".hdf5")):
             from blit.io.fbh5 import write_fbh5
 
@@ -274,7 +275,7 @@ class RawReducer:
             write_fil(out_path, hdr, data)
         return hdr
 
-    def reduce_resumable(self, raw_path: str, out_path: str) -> Dict:
+    def reduce_resumable(self, raw_src: RawSource, out_path: str) -> Dict:
         """Reduce to a ``.fil`` product with crash-resumable streaming.
 
         A :class:`ReductionCursor` sidecar records frames written after every
@@ -282,21 +283,27 @@ class RawReducer:
         tail and continues from the last completed chunk (block-boundary
         restart, SURVEY.md §5 "Checkpoint / resume").  The finished product is
         byte-identical to a non-resumed run; the sidecar is removed on
-        completion.
+        completion.  Multi-file scan sequences resume the same way — the
+        cursor records every member file's identity, and the skip-frames
+        restart lands wherever in the sequence the frames do (including
+        across a file boundary).
         """
         if out_path.endswith((".h5", ".hdf5")):
             raise ValueError("reduce_resumable writes .fil (appendable) products")
         from blit.io.sigproc import read_fil_header, write_fil
 
-        raw = GuppiRaw(raw_path)
+        raw = open_raw(raw_src)
         if raw.nblocks == 0:
-            raise ValueError(f"empty or fully truncated RAW file: {raw_path}")
+            raise ValueError(f"empty or fully truncated RAW file: {raw.path}")
+        # Cursor identity: the member path list (single files keep the plain
+        # string so pre-existing sidecars stay valid).
+        paths = getattr(raw, "paths", None) or raw.path
         hdr = self.header_for(raw)
         nif = STOKES_NIF[self.stokes]
         spectrum_bytes = nif * hdr["nchans"] * 4  # float32 products
 
         cur = ReductionCursor.load(out_path)
-        if cur is not None and cur.matches(self, raw_path) and os.path.exists(out_path):
+        if cur is not None and cur.matches(self, paths) and os.path.exists(out_path):
             _, data_off = read_fil_header(out_path)
             good = data_off + (cur.frames_done // self.nint) * spectrum_bytes
             with open(out_path, "r+b") as f:
@@ -306,9 +313,9 @@ class RawReducer:
             write_fil(
                 out_path, hdr, np.zeros((0, nif, hdr["nchans"]), np.float32)
             )
-            size, mtime_ns = ReductionCursor.stat_raw(raw_path)
+            size, mtime_ns = ReductionCursor.stat_raw(paths)
             cur = ReductionCursor(
-                raw_path, self.nfft, self.ntap, self.nint, self.stokes, 0,
+                paths, self.nfft, self.ntap, self.nint, self.stokes, 0,
                 window=self.window, raw_size=size, raw_mtime_ns=mtime_ns,
             )
             cur.save(out_path)
@@ -360,22 +367,29 @@ class ReductionCursor:
     must match, and the RAW input must be the same bytes it was
     (size + mtime_ns recorded at cursor creation) — otherwise a resume would
     silently splice spectra from different configs/inputs into one product.
+    For multi-file scan sequences ``raw_path``/``raw_size``/``raw_mtime_ns``
+    hold per-member lists: every member of the sequence must be unchanged.
     """
 
-    raw_path: str
+    raw_path: Union[str, List[str]]
     nfft: int
     ntap: int
     nint: int
     stokes: str
     frames_done: int = 0
     window: str = "hamming"
-    raw_size: int = -1
-    raw_mtime_ns: int = -1
+    raw_size: Union[int, List[int]] = -1
+    raw_mtime_ns: Union[int, List[int]] = -1
 
     @staticmethod
-    def stat_raw(raw_path: str) -> Tuple[int, int]:
-        st = os.stat(raw_path)
-        return st.st_size, st.st_mtime_ns
+    def stat_raw(raw_path: Union[str, Sequence[str]]) -> Tuple:
+        """(size, mtime_ns) of a single path, or parallel lists for a
+        sequence of paths."""
+        if isinstance(raw_path, str):
+            st = os.stat(raw_path)
+            return st.st_size, st.st_mtime_ns
+        stats = [os.stat(p) for p in raw_path]
+        return [s.st_size for s in stats], [s.st_mtime_ns for s in stats]
 
     @staticmethod
     def path_for(out_path: str) -> str:
@@ -401,18 +415,22 @@ class ReductionCursor:
         except (OSError, ValueError, TypeError):
             return None
 
-    def matches(self, red: "RawReducer", raw_path: str) -> bool:
+    def matches(self, red: "RawReducer", raw_path: Union[str, Sequence[str]]) -> bool:
         try:
             size, mtime_ns = self.stat_raw(raw_path)
         except OSError:
             return False
+
+        def norm(x):
+            return list(x) if isinstance(x, (list, tuple)) else [x]
+
         return (
-            self.raw_path == raw_path
+            norm(self.raw_path) == norm(raw_path)
             and self.nfft == red.nfft
             and self.ntap == red.ntap
             and self.nint == red.nint
             and self.stokes == red.stokes
             and self.window == red.window
-            and self.raw_size == size
-            and self.raw_mtime_ns == mtime_ns
+            and norm(self.raw_size) == norm(size)
+            and norm(self.raw_mtime_ns) == norm(mtime_ns)
         )

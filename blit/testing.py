@@ -157,6 +157,44 @@ def synth_raw(
     return hdr, blocks
 
 
+def synth_raw_sequence(
+    stem: str,
+    nfiles: int = 2,
+    blocks_per_file: int = 2,
+    obsnchan: int = 64,
+    ntime_per_block: int = 1024,
+    npol: int = 2,
+    overlap: int = 0,
+    seed: int = 0,
+    tone_chan: Optional[int] = None,
+    **hdrkw,
+) -> Tuple[List[str], np.ndarray]:
+    """Write a multi-file ``.NNNN.raw`` scan sequence carrying ONE contiguous
+    voltage stream (the on-disk GBT recording layout: the block stream —
+    including the OVERLAP convention — continues across file boundaries).
+
+    Returns ``(paths, stream)`` where ``stream`` is the full gap-free
+    voltage stream the sequence encodes.
+    """
+    nblocks = nfiles * blocks_per_file
+    hdr = make_raw_header(obsnchan=obsnchan, npol=npol, overlap=overlap, **hdrkw)
+    step = ntime_per_block - overlap
+    total = step * (nblocks - 1) + ntime_per_block
+    stream = make_voltages(obsnchan, total, npol, seed=seed, tone_chan=tone_chan)
+    blocks = [
+        stream[:, i * step : i * step + ntime_per_block] for i in range(nblocks)
+    ]
+    paths = []
+    for f in range(nfiles):
+        p = f"{stem}.{f:04d}.raw"
+        fhdr = dict(hdr)
+        # PKTIDX continues across files (write_raw advances it per block).
+        fhdr["PKTIDX"] = f * blocks_per_file * step
+        write_raw(p, fhdr, blocks[f * blocks_per_file : (f + 1) * blocks_per_file])
+        paths.append(p)
+    return paths, stream
+
+
 def build_observation_tree(
     root: str,
     session: str = "AGBT22B_999_01",

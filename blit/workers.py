@@ -119,7 +119,7 @@ def get_freq_axis(header: Dict, fqav_by: int = 1) -> Tuple[float, float, int]:
 
 
 def reduce_raw(
-    raw_path: str,
+    raw_path,
     out_path: Optional[str] = None,
     product: Optional[str] = None,
     nfft: int = 1024,
@@ -128,9 +128,11 @@ def reduce_raw(
     resume: bool = False,
     **reducer_kw,
 ):
-    """Reduce a GUPPI RAW file to a filterbank product on this worker — the
-    rawspec-equivalent stage the reference assumes already ran on each node
-    (SURVEY.md §0 "File products").
+    """Reduce a GUPPI RAW recording to a filterbank product on this worker —
+    the rawspec-equivalent stage the reference assumes already ran on each
+    node (SURVEY.md §0 "File products").  ``raw_path`` may be a single file,
+    a ``.NNNN.raw`` sequence stem, or a path list: multi-file scans stream
+    as one gap-free reduction (blit/io/guppi.GuppiScan).
 
     ``product`` selects a standard rawspec preset ("0000"/"0001"/"0002",
     blit/pipeline.py); otherwise ``nfft``/``nint``/``stokes`` configure the

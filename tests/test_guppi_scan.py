@@ -1,0 +1,226 @@
+"""Multi-file GUPPI RAW scan sequences (blit/io/guppi.GuppiScan).
+
+A GBT scan is recorded as ``<stem>.0000.raw, .0001.raw, ...`` — the NNNN
+field of the reference's filename grammar (src/gbtworkerfunctions.jl:35-47;
+README.md:25-27) — and rawspec consumes the whole sequence as one gap-free
+stream.  These tests pin that contract: reducing the sequence must equal
+reducing the concatenated recording, including across OVERLAP-carrying file
+boundaries, and a resumable reduction must restart cleanly mid-sequence.
+"""
+
+import numpy as np
+import pytest
+
+from blit.io.guppi import (
+    GuppiRaw,
+    GuppiScan,
+    open_raw,
+    scan_files,
+    write_raw,
+)
+from blit.testing import make_raw_header, synth_raw_sequence
+
+
+class TestScanFiles:
+    def test_expands_member_and_stem(self, tmp_path):
+        stem = str(tmp_path / "guppi_59897_21221_HD_84406_0011")
+        paths, _ = synth_raw_sequence(stem, nfiles=3, obsnchan=2,
+                                      ntime_per_block=64)
+        assert scan_files(stem) == paths
+        assert scan_files(paths[1]) == paths
+
+    def test_sorted_numerically(self, tmp_path):
+        # NNNN is zero-padded: lexical sort == numeric sort even past 9.
+        stem = str(tmp_path / "x")
+        hdr = make_raw_header(obsnchan=2)
+        blk = np.zeros((2, 64, 2, 2), np.int8)
+        for i in (11, 2, 0):
+            write_raw(f"{stem}.{i:04d}.raw", hdr, [blk])
+        assert [p[-8:-4] for p in scan_files(stem)] == ["0000", "0002", "0011"]
+
+    def test_no_match_empty(self, tmp_path):
+        assert scan_files(str(tmp_path / "nothing")) == []
+
+
+class TestGuppiScan:
+    @pytest.mark.parametrize("overlap", [0, 32])
+    def test_kept_stream_equals_recording(self, tmp_path, overlap):
+        # The sequence's overlap-trimmed block stream must reproduce the
+        # original contiguous recording exactly — including the trim of the
+        # *last block of each non-final file* (its OVERLAP tail repeats at
+        # the start of the next file).
+        stem = str(tmp_path / "y")
+        paths, stream = synth_raw_sequence(
+            stem, nfiles=2, blocks_per_file=2, obsnchan=3,
+            ntime_per_block=128 + overlap, overlap=overlap,
+        )
+        scan = GuppiScan(paths)
+        assert scan.nblocks == 4
+        got = np.concatenate(
+            [blk for _, blk in scan.iter_blocks(drop_overlap=True)], axis=1
+        )
+        np.testing.assert_array_equal(got, stream)
+        # read_block_into path (what the streaming ring uses):
+        total = sum(scan.block_ntime_kept(i) for i in range(scan.nblocks))
+        assert total == stream.shape[1]
+        out = np.empty((3, total, 2, 2), np.int8)
+        filled = 0
+        for i in range(scan.nblocks):
+            nt = scan.block_ntime_kept(i)
+            scan.read_block_into(i, out[:, filled:], t0=0, ntime_keep=nt)
+            filled += nt
+        np.testing.assert_array_equal(out, stream)
+
+    def test_single_file_scan_matches_guppiraw(self, tmp_path):
+        stem = str(tmp_path / "z")
+        paths, stream = synth_raw_sequence(stem, nfiles=1, blocks_per_file=3,
+                                           obsnchan=2, ntime_per_block=64)
+        scan = GuppiScan(paths)
+        raw = GuppiRaw(paths[0])
+        assert scan.nblocks == raw.nblocks
+        for i in range(scan.nblocks):
+            assert scan.block_ntime_kept(i) == raw.block_ntime_kept(i)
+            np.testing.assert_array_equal(scan.read_block(i), raw.read_block(i))
+
+    def test_pktidx_gap_warns_and_strict_raises(self, tmp_path, caplog):
+        stem = str(tmp_path / "g")
+        paths, _ = synth_raw_sequence(stem, nfiles=2, blocks_per_file=2,
+                                      obsnchan=2, ntime_per_block=64)
+        # Rewrite file 1 with a bogus PKTIDX origin: a dropped-block gap.
+        raw1 = GuppiRaw(paths[1])
+        hdr = dict(raw1.header(0))
+        hdr["PKTIDX"] = hdr["PKTIDX"] + 640
+        # Materialize (read_block may memmap the file being rewritten).
+        blocks = [np.array(raw1.read_block(i)) for i in range(raw1.nblocks)]
+        del raw1
+        write_raw(paths[1], hdr, blocks)
+        with caplog.at_level("WARNING", logger="blit.guppi"):
+            GuppiScan(paths)
+        assert any("PKTIDX gap" in r.message for r in caplog.records)
+        with pytest.raises(ValueError, match="PKTIDX gap"):
+            GuppiScan(paths, strict=True)
+
+    def test_missing_member_warns(self, tmp_path, caplog):
+        stem = str(tmp_path / "m")
+        paths, _ = synth_raw_sequence(stem, nfiles=3, blocks_per_file=1,
+                                      obsnchan=2, ntime_per_block=64)
+        import os
+
+        os.unlink(paths[1])
+        with caplog.at_level("WARNING", logger="blit.guppi"):
+            GuppiScan(scan_files(stem))
+        assert any("missing sequence numbers" in r.message for r in caplog.records)
+
+    def test_geometry_mismatch_rejected(self, tmp_path):
+        hdr = make_raw_header(obsnchan=2)
+        write_raw(str(tmp_path / "a.0000.raw"), hdr,
+                  [np.zeros((2, 64, 2, 2), np.int8)])
+        hdr4 = make_raw_header(obsnchan=4)
+        write_raw(str(tmp_path / "a.0001.raw"), hdr4,
+                  [np.zeros((4, 64, 2, 2), np.int8)])
+        with pytest.raises(ValueError, match="disagrees"):
+            GuppiScan(scan_files(str(tmp_path / "a")))
+
+
+class TestOpenRaw:
+    def test_dispatch(self, tmp_path):
+        stem = str(tmp_path / "d")
+        paths, _ = synth_raw_sequence(stem, nfiles=2, blocks_per_file=1,
+                                      obsnchan=2, ntime_per_block=64)
+        assert isinstance(open_raw(paths[0]), GuppiRaw)  # explicit file
+        assert isinstance(open_raw(stem), GuppiScan)  # stem expands
+        assert isinstance(open_raw(paths), GuppiScan)  # list
+        assert isinstance(open_raw([paths[0]]), GuppiRaw)  # 1-list
+        scan = GuppiScan(paths)
+        assert open_raw(scan) is scan  # passthrough
+        with pytest.raises(FileNotFoundError):
+            open_raw(str(tmp_path / "absent"))
+
+
+class TestSequenceReduction:
+    @pytest.mark.parametrize("overlap", [0, 32])
+    def test_sequence_reduction_equals_concatenation(self, tmp_path, overlap):
+        # THE golden test: reducing a 2-file sequence == reducing the single
+        # file holding the same blocks (PFB state carried across the file
+        # boundary; boundary invisible in the product).
+        pytest.importorskip("jax")
+        from blit.pipeline import RawReducer
+
+        stem = str(tmp_path / "seq")
+        paths, stream = synth_raw_sequence(
+            stem, nfiles=2, blocks_per_file=2, obsnchan=2,
+            ntime_per_block=512 + overlap, overlap=overlap, tone_chan=1,
+        )
+        # One file holding the identical gap-free recording:
+        mono = str(tmp_path / "mono.raw")
+        hdr = make_raw_header(obsnchan=2, overlap=0)
+        write_raw(mono, hdr, [stream])
+
+        red = RawReducer(nfft=64, nint=2, chunk_frames=4)
+        hdr_seq, data_seq = red.reduce(paths)
+        _, data_mono = RawReducer(nfft=64, nint=2, chunk_frames=4).reduce(mono)
+        np.testing.assert_array_equal(data_seq, data_mono)
+        # Stem form drives the same reduction.
+        _, data_stem = RawReducer(nfft=64, nint=2, chunk_frames=4).reduce(stem)
+        np.testing.assert_array_equal(data_stem, data_seq)
+
+    def test_resume_across_file_boundary(self, tmp_path):
+        # Crash mid-sequence, resume, compare against an uninterrupted run.
+        pytest.importorskip("jax")
+        from blit.io.sigproc import read_fil_data
+        from blit.pipeline import RawReducer, ReductionCursor
+
+        stem = str(tmp_path / "r")
+        paths, _ = synth_raw_sequence(
+            stem, nfiles=2, blocks_per_file=2, obsnchan=2,
+            ntime_per_block=512, tone_chan=1,
+        )
+        out = str(tmp_path / "r.fil")
+
+        class Boom(Exception):
+            pass
+
+        orig_stream = RawReducer.stream
+
+        def crashing_stream(self, raw_, skip_frames=0):
+            for i, slab in enumerate(orig_stream(self, raw_, skip_frames)):
+                if i == 5:
+                    raise Boom()
+                yield slab
+
+        red = RawReducer(nfft=64, nint=1, chunk_frames=4)
+        try:
+            RawReducer.stream = crashing_stream
+            with pytest.raises(Boom):
+                red.reduce_resumable(stem, out)
+        finally:
+            RawReducer.stream = orig_stream
+
+        cur = ReductionCursor.load(out)
+        # 20 frames done -> the resume skip (20*64 = 1280 samples) lands
+        # INSIDE file 1 (files split at sample 1024): the restart must seek
+        # through the boundary correctly.
+        assert cur is not None and cur.frames_done == 20
+        assert cur.raw_path == paths  # per-member identity recorded
+
+        RawReducer(nfft=64, nint=1, chunk_frames=4).reduce_resumable(stem, out)
+        _, data = read_fil_data(out)
+        _, want = RawReducer(nfft=64, nint=1, chunk_frames=4).reduce(paths)
+        np.testing.assert_array_equal(np.asarray(data), want)
+
+    def test_resume_rejects_modified_member(self, tmp_path):
+        pytest.importorskip("jax")
+        from blit.pipeline import RawReducer, ReductionCursor
+
+        stem = str(tmp_path / "t")
+        paths, _ = synth_raw_sequence(stem, nfiles=2, blocks_per_file=1,
+                                      obsnchan=2, ntime_per_block=512)
+        red = RawReducer(nfft=64, nint=1, chunk_frames=4)
+        size, mtime = ReductionCursor.stat_raw(paths)
+        cur = ReductionCursor(paths, nfft=64, ntap=4, nint=1, stokes="I",
+                              frames_done=4, window=red.window,
+                              raw_size=size, raw_mtime_ns=mtime)
+        assert cur.matches(red, paths)
+        with open(paths[1], "ab") as f:
+            f.write(b"\0")
+        assert not cur.matches(red, paths)
