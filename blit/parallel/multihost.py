@@ -38,6 +38,7 @@ def init_multihost(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
+    cpu_collectives: Optional[str] = None,
     **kw,
 ) -> bool:
     """Initialize the JAX distributed runtime (idempotent).
@@ -46,11 +47,20 @@ def init_multihost(
     metadata / cluster env vars), which is also correct for single-process
     runs — ``jax.distributed.initialize`` is then a no-op.  Returns True if
     a multi-process runtime is active afterwards.
+
+    ``cpu_collectives``: cross-process collective implementation for the
+    CPU backend (``"gloo"`` / ``"mpi"``) — required for a multi-process CPU
+    pod (the multi-host test rig); TPU pods ignore it (ICI/DCN collectives
+    are built in).
     """
     global _initialized
     import jax
 
     if not _initialized:
+        if cpu_collectives:
+            jax.config.update(
+                "jax_cpu_collectives_implementation", cpu_collectives
+            )
         try:
             jax.distributed.initialize(
                 coordinator_address=coordinator_address,

@@ -57,6 +57,42 @@ def _gapless(raw: GuppiRaw, max_samples: Optional[int]) -> np.ndarray:
     return out
 
 
+# Per-player markers riding the pod-wide sample-count agreement.  ERR < UNFED
+# so an owner's failure wins the cross-process MIN over "nobody fed it", and
+# both exceed any real sample count (~1e11 for a 10-minute bank recording).
+_SAMPS_ERR = 1 << 60  # the owning process failed to open/read the player
+_SAMPS_UNFED = 1 << 61  # no process fed this player
+
+
+def _gather_int64(local: np.ndarray) -> np.ndarray:
+    """Allgather an int64 array across every process → ``(nproc, ...)`` —
+    the pod-wide agreement primitive behind the common-frame-span decision.
+    Every process sees every process's values, so any consistency check made
+    on the result raises (or passes) SYMMETRICALLY — no process can proceed
+    into the collectives while a peer errors out (that asymmetry would trade
+    a clean error for a distributed hang).
+
+    ``process_allgather`` canonicalizes dtypes (int64 → int32 with x64 off),
+    which would corrupt sample counts past 2^31 — so values ride as exact
+    (hi, lo) int32 pairs.  Single-process: ``local[None]``.
+    """
+    import jax
+
+    if jax.process_count() == 1:
+        return local[None]
+    from jax.experimental import multihost_utils
+
+    if (local < 0).any() or (local >= (1 << 62)).any():
+        raise ValueError("_gather_int64: values must be in [0, 2^62)")
+    hi = (local >> 31).astype(np.int32)
+    lo = (local & 0x7FFFFFFF).astype(np.int32)
+    g = multihost_utils.process_allgather(
+        np.stack([hi, lo]).reshape((2,) + local.shape)
+    )  # (nproc, 2, ...)
+    g = np.asarray(g, np.int64)
+    return (g[:, 0] << 31) | g[:, 1]  # (nproc, ...)
+
+
 def load_scan_mesh(
     raw_paths: Sequence[Sequence[str]],
     *,
@@ -71,6 +107,15 @@ def load_scan_mesh(
     mesh=None,
 ) -> Tuple[Dict, "object"]:
     """Reduce one scan's RAW files across the mesh and stitch each band.
+
+    Multi-process pods are first-class: under ``jax.distributed`` each
+    process opens and feeds ONLY the players whose chips it owns
+    (:func:`blit.parallel.multihost.local_players`) — the TPU analog of the
+    reference's one-worker-per-host file locality (src/gbt.jl:28-42), where
+    each ``blc*`` host serves its own disks.  Non-local entries of
+    ``raw_paths`` are never touched, so they may name files that exist only
+    on the owning host.  The common whole-frame span is agreed pod-wide
+    (every process must build the same global array shape).
 
     Args:
       raw_paths: ``raw_paths[band][bank]`` — one RAW source per player, all
@@ -88,9 +133,15 @@ def load_scan_mesh(
       ``(header, stitched)`` where stitched is a jax.Array
       ``(nband, ntime_out, nif, nbank*nchan*nfft)`` sharded over ``band``
       (replicated across each band's banks), and ``header`` is the full-band
-      filterbank header (validated contiguous across banks).
+      filterbank header.  Contiguity across banks is validated from the
+      headers this process can see (all of them single-process; the local
+      players' in a pod); the header is derived from this process's lowest
+      (band, bank) player, which describes every band of the same scan.
     """
+    import jax
     import jax.numpy as jnp
+
+    from blit.parallel.multihost import local_players
 
     nband = len(raw_paths)
     nbank = len(raw_paths[0])
@@ -99,16 +150,66 @@ def load_scan_mesh(
     if mesh is None:
         mesh = M.make_mesh(nband, nbank)
 
-    raws = [[open_raw(p) for p in row] for row in raw_paths]
-    for row in raws:
-        for r in row:
+    local = sorted(local_players(mesh))
+    if not local:
+        raise ValueError(
+            "this process owns no device of the scan mesh "
+            f"(process {jax.process_index()}/{jax.process_count()})"
+        )
+    # Open this process's players.  Failures do NOT raise yet: the owner
+    # must first tell the pod via the agreement below, so every process
+    # raises together instead of the peers hanging in the collectives.
+    raws = {}
+    local_errs = {}
+    for b, k in local:
+        try:
+            r = open_raw(raw_paths[b][k])
             if r.nblocks == 0:
                 raise ValueError(f"empty RAW file: {r.path}")
+            raws[(b, k)] = r
+        except Exception as e:  # noqa: BLE001 — reported pod-wide below
+            local_errs[(b, k)] = e
+
+    if raws:
+        first = raws[sorted(raws)[0]].header(0)
+        nchan = first["OBSNCHAN"]
+        npol = 2 if first["NPOL"] > 2 else first["NPOL"]
+    else:
+        nchan = npol = 0  # nothing openable; the ERR agreement raises below
 
     # Common whole-frame span across every player (ragged recordings trim),
     # via the same frame-accounting invariant the streaming pipeline uses.
     # Header arithmetic only — each file's data is read exactly once, below.
-    min_samps = min(_kept_samples(r) for row in raws for r in row)
+    # The span, the (nchan, npol) geometry, and any per-player failures are
+    # agreed across processes: every process must assemble the same global
+    # array shape — and must error together — or the collectives deadlock.
+    samps = np.full((nband, nbank), _SAMPS_UNFED, np.int64)
+    for (b, k), r in raws.items():
+        samps[b, k] = _kept_samples(r)
+    for bk in local_errs:
+        samps[bk] = _SAMPS_ERR
+    gathered = _gather_int64(np.concatenate([samps.ravel(), [nchan, npol]]))
+    samps = gathered[:, :-2].min(axis=0).reshape(nband, nbank)
+    failed = [tuple(i) for i in np.argwhere(samps == _SAMPS_ERR)]
+    if failed:
+        mine = "; ".join(
+            f"{bk}: {type(e).__name__}: {e}" for bk, e in sorted(local_errs.items())
+        )
+        cause = next(iter(local_errs.values()), None)
+        raise ValueError(
+            f"players {failed} failed to open on their owning process"
+            + (f" (this process: {mine})" if mine else "")
+        ) from cause
+    unfed = [tuple(i) for i in np.argwhere(samps == _SAMPS_UNFED)]
+    if unfed:
+        raise ValueError(f"no process fed players {unfed}")
+    geo = gathered[:, -2:]
+    geo = geo[(geo != 0).any(axis=1)]
+    if not (geo == geo[0]).all():
+        raise ValueError(
+            f"processes disagree on (nchan, npol): {[tuple(g) for g in geo]}"
+        )
+    min_samps = int(samps.min())
     frames = usable_frames(min_samps, nfft, ntap, nint)
     if max_frames is not None:
         frames = min(frames, (max_frames // nint) * nint)
@@ -118,27 +219,23 @@ def load_scan_mesh(
         )
     ntime = (frames + ntap - 1) * nfft
 
-    first = raws[0][0].header(0)
-    nchan = first["OBSNCHAN"]
-    npol = 2 if first["NPOL"] > 2 else first["NPOL"]
-    # One bank in host memory at a time: each player's block goes straight
-    # onto its chip, and the global array is assembled from the
-    # single-device shards (no whole-scan host buffer).
-    import jax
-
+    # One bank in host memory at a time: each local player's block goes
+    # straight onto its chip, and the global array is assembled from the
+    # single-device shards (no whole-scan host buffer, no device_put to any
+    # non-addressable device).
     sharding = M.voltage_sharding(mesh)
     global_shape = (nband, nbank, nchan, ntime, npol, 2)
     shards = []
-    for b, row in enumerate(raws):
-        for k, r in enumerate(row):
-            v = _gapless(r, ntime)
-            if v.shape[0] != nchan or v.shape[1] < ntime or v.shape[2:] != (npol, 2):
-                raise ValueError(
-                    f"{r.path}: shape {v.shape} incompatible with "
-                    f"(nchan={nchan}, ntime>={ntime}, npol={npol}, 2)"
-                )
-            block = np.ascontiguousarray(v[None, None, :, :ntime])
-            shards.append(jax.device_put(block, mesh.devices[b, k]))
+    for b, k in local:
+        r = raws[(b, k)]
+        v = _gapless(r, ntime)
+        if v.shape[0] != nchan or v.shape[1] < ntime or v.shape[2:] != (npol, 2):
+            raise ValueError(
+                f"{r.path}: shape {v.shape} incompatible with "
+                f"(nchan={nchan}, ntime>={ntime}, npol={npol}, 2)"
+            )
+        block = np.ascontiguousarray(v[None, None, :, :ntime])
+        shards.append(jax.device_put(block, mesh.devices[b, k]))
     volt = jax.make_array_from_single_device_arrays(
         global_shape, sharding, shards
     )
@@ -157,21 +254,30 @@ def load_scan_mesh(
         despike_nfpc=nfft if despike else 0,
     )
 
-    # Full-band header: per-bank headers must tile contiguously in frequency.
-    hdrs = [output_header(r.header(0), nfft=nfft, nint=nint, stokes=stokes)
-            for r in raws[0]]
-    foff = hdrs[0]["foff"]
-    per_bank = hdrs[0]["nchans"]
-    for k, h in enumerate(hdrs):
+    # Full-band header: per-bank headers must tile contiguously in
+    # frequency.  Validated over the headers this process can see; each
+    # local bank k implies the band's bank-0 fch1 (fch1_k - k*per_bank*foff),
+    # and all must agree.
+    hdrs = {
+        (b, k): output_header(r.header(0), nfft=nfft, nint=nint, stokes=stokes)
+        for (b, k), r in raws.items()
+    }
+    h0 = hdrs[local[0]]
+    foff = h0["foff"]
+    per_bank = h0["nchans"]
+    bases: Dict[int, float] = {}
+    for (b, k), h in sorted(hdrs.items()):
         if abs(h["foff"] - foff) > 1e-12:
             raise ValueError("banks disagree on fine channel width")
-        expect = hdrs[0]["fch1"] + k * per_bank * foff
-        if abs(h["fch1"] - expect) > abs(foff) / 2:
+        base = h["fch1"] - k * per_bank * foff
+        if b in bases and abs(base - bases[b]) > abs(foff) / 2:
             log.warning(
-                "bank %d not contiguous: fch1=%.6f expected %.6f",
-                k, h["fch1"], expect,
+                "band %d bank %d not contiguous: fch1=%.6f expected %.6f",
+                b, k, h["fch1"], bases[b] + k * per_bank * foff,
             )
-    hdr = dict(hdrs[0])
+        bases.setdefault(b, base)
+    hdr = dict(h0)
+    hdr["fch1"] = bases[local[0][0]]
     hdr["nchans"] = nbank * per_bank
     hdr["nsamps"] = int(out.shape[1])
     hdr["nifs"] = STOKES_NIF[stokes]

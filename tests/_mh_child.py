@@ -1,0 +1,112 @@
+"""Child process for the 2-process pod test (tests/test_multiprocess.py).
+
+Run as: ``python tests/_mh_child.py <pid> <nproc> <port> <outdir>``.
+
+Each child initializes the JAX distributed runtime against a localhost
+coordinator (CPU backend, gloo collectives), synthesizes RAW files for ONLY
+the (band, bank) players whose virtual chips it owns, and runs the full
+``load_scan_mesh`` reduction — the data-feed locality of the reference's
+one-worker-per-host deployment (src/gbt.jl:28-42) on the TPU-pod analog.
+Results (local player set, per-band stitched rows) land in ``outdir`` for
+the parent test to validate against the single-process golden.
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> None:
+    pid, nproc, port, outdir = (
+        int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4]
+    )
+    # Optional sabotage: "b,k" names one player whose file is NOT written —
+    # the owner must fail to open it and EVERY process must raise (the
+    # symmetric-error contract that keeps a pod misconfiguration from
+    # hanging the peers inside the collectives).
+    sabotage = None
+    if len(sys.argv) > 5 and sys.argv[5]:
+        sabotage = tuple(int(x) for x in sys.argv[5].split(","))
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from blit.parallel.multihost import init_multihost, local_players
+
+    active = init_multihost(
+        coordinator_address=f"localhost:{port}",
+        num_processes=nproc,
+        process_id=pid,
+        cpu_collectives="gloo",
+    )
+    assert active, "expected an active multi-process runtime"
+    assert jax.process_count() == nproc, jax.process_count()
+
+    import numpy as np
+
+    from blit.parallel.mesh import make_mesh
+    from blit.parallel.scan import load_scan_mesh
+    from blit.testing import synth_raw
+
+    NBAND, NBANK, NFFT, NINT, NCHAN = 2, 4, 32, 2, 2
+    mesh = make_mesh(NBAND, NBANK)
+    local = sorted(local_players(mesh))
+
+    # Write ONLY this process's players' files, into a private directory:
+    # the grid entries for non-local players name files that do not exist
+    # here, proving load_scan_mesh never touches them.
+    priv = os.path.join(outdir, f"proc{pid}")
+    os.makedirs(priv, exist_ok=True)
+    bank_bw = -187.5 / NBANK
+    paths = [
+        [os.path.join(priv, f"blc{b}{k}.raw") for k in range(NBANK)]
+        for b in range(NBAND)
+    ]
+    for b, k in local:
+        if (b, k) == sabotage:
+            continue
+        synth_raw(
+            paths[b][k], nblocks=2, obsnchan=NCHAN, ntime_per_block=512,
+            seed=b * 8 + k, tone_chan=k % NCHAN, obsbw=bank_bw,
+            obsfreq=8000.0 + b * 500.0 + (k + 0.5) * bank_bw,
+        )
+
+    if sabotage is not None:
+        try:
+            load_scan_mesh(paths, nfft=NFFT, nint=NINT, despike=False,
+                           mesh=mesh)
+        except ValueError as e:
+            assert "failed to open" in str(e), e
+            print(f"CHILD-SYMMETRIC-ERROR:{pid}", flush=True)
+            return
+        raise AssertionError("sabotaged pod did not raise")
+
+    hdr, out = load_scan_mesh(
+        paths, nfft=NFFT, nint=NINT, despike=False, mesh=mesh
+    )
+    assert hdr["nchans"] == NBANK * NCHAN * NFFT, hdr
+
+    rows = {}
+    for s in out.addressable_shards:
+        if s.replica_id == 0:
+            band = int(s.index[0].start or 0)
+            rows[band] = np.asarray(s.data)[0]
+    for band, row in rows.items():
+        np.save(os.path.join(outdir, f"band{band}_proc{pid}.npy"), row)
+    with open(os.path.join(outdir, f"proc{pid}.json"), "w") as f:
+        json.dump(
+            {
+                "local": [list(x) for x in local],
+                "bands": sorted(rows),
+                "nsamps": int(hdr["nsamps"]),
+                "fch1": hdr["fch1"],
+                "foff": hdr["foff"],
+                "nchans": int(hdr["nchans"]),
+            },
+            f,
+        )
+    print("CHILD-OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()
