@@ -118,16 +118,25 @@ def get_kurtosis(
     worker_ids: Sequence[int],
     fnames: Sequence[str],
     idxs: Idxs = _ALL,
+    device: bool = False,
     *,
     pool: Optional[WorkerPool] = None,
     on_error: str = "raise",
 ) -> List[np.ndarray]:
     """Per-file excess-kurtosis maps, shape (nchan, nifs) each (reference:
-    ``GBT.getkurtosis``, src/gbt.jl:81-88)."""
+    ``GBT.getkurtosis``, src/gbt.jl:81-88).  ``device=True`` runs each
+    worker's moment reduction on its accelerator under jit
+    (:func:`blit.workers.get_kurtosis`)."""
     if len(worker_ids) != len(fnames):
         raise ValueError("worker_ids and fnames must have the same size")
     p = _pool(pool)
-    return p.run_on(worker_ids, wf.get_kurtosis, [(f, idxs) for f in fnames], on_error=on_error)
+    return p.run_on(
+        worker_ids,
+        wf.get_kurtosis,
+        [(f, idxs) for f in fnames],
+        kwargs={"device": device},
+        on_error=on_error,
+    )
 
 
 def load_scan(

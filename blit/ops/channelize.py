@@ -40,6 +40,7 @@ import jax
 import jax.numpy as jnp
 
 from blit.ops import dft as dftmod
+from blit.ops.fqav import fqav as _fqav
 
 STOKES_NIF = {"I": 1, "XX": 1, "YY": 1, "XXYY": 2, "full": 4, "IQUV": 4}
 
@@ -272,7 +273,7 @@ def integrate(power: jax.Array, nint: int) -> jax.Array:
     jax.jit,
     static_argnames=(
         "nfft", "ntap", "nint", "stokes", "fft_method", "precision",
-        "channel_block", "dtype",
+        "channel_block", "dtype", "fqav_by",
     ),
 )
 def channelize(
@@ -287,6 +288,7 @@ def channelize(
     precision: Optional[str] = None,
     channel_block: int = 0,
     dtype: str = "float32",
+    fqav_by: int = 1,
 ) -> jax.Array:
     """The full single-chip reduction: int8 voltage block → filterbank slab.
 
@@ -314,6 +316,12 @@ def channelize(
         detected powers accumulate in float32 (the cast happens at the DFT
         boundary, where the MXU truncates to bf16-grade products by default
         anyway).  Measured accuracy: see DESIGN.md §1/§8.
+      fqav_by: on-device frequency-averaging epilogue — sum every
+        ``fqav_by`` consecutive fine channels (reference ``fqav`` default-f
+        semantics, src/gbtworkerfunctions.jl:16-20) before anything leaves
+        the chip, shrinking the product (and any host readback) by that
+        factor.  Callers must map the channel axis with
+        :func:`blit.ops.fqav.fqav_range`.
 
     Returns:
       float32 ``(ntime_out, nif, nchan_coarse*nfft)`` in blit's canonical
@@ -342,6 +350,10 @@ def channelize(
 
     if dtype not in ("float32", "bfloat16"):
         raise ValueError(f"dtype must be float32 or bfloat16, got {dtype!r}")
+    if fqav_by > 1 and nfft % fqav_by:
+        # nchan*nfft divisibility alone would let averaging groups straddle
+        # coarse-channel boundaries, corrupting nfpc-keyed consumers.
+        raise ValueError(f"fqav_by={fqav_by} does not divide nfft={nfft}")
 
     def core(v):
         re, im = dequantize(v)  # (cb, ntime, npol) each
@@ -373,7 +385,10 @@ def channelize(
         power = core(voltages)
     # → (ntime_out, nif, nchan*nfft), channel fastest.
     out = jnp.transpose(power, (2, 1, 0, 3))
-    return out.reshape(out.shape[0], out.shape[1], nchan * nfft)
+    out = out.reshape(out.shape[0], out.shape[1], nchan * nfft)
+    if fqav_by > 1:
+        out = _fqav(out, fqav_by)
+    return out
 
 
 def channelize_np(

@@ -74,6 +74,11 @@ class RawReducer:
     stokes: str = "I"
     window: str = "hamming"
     fft_method: str = "auto"
+    # On-device frequency-averaging epilogue: sum every fqav_by consecutive
+    # fine channels before the product leaves the chip (the reference's
+    # reduce-before-the-wire lever, src/gbtworkerfunctions.jl:16-20, moved
+    # into the jitted kernel).  Headers carry the fqav_range mapping.
+    fqav_by: int = 1
     # Output frames per device call; rounded up to a multiple of nint.
     chunk_frames: Optional[int] = None
     # Per-stage timing/byte registry ("ingest" / "device" / "stream").
@@ -96,6 +101,12 @@ class RawReducer:
             self.chunk_frames = self.nint * max(1, min(64, budget) // self.nint)
         if self.chunk_frames % self.nint:
             self.chunk_frames += self.nint - self.chunk_frames % self.nint
+        if self.fqav_by > 1 and self.nfft % self.fqav_by:
+            # Averaging groups must not straddle coarse-channel boundaries
+            # (despike/nfpc consumers key on fine-per-coarse counts).
+            raise ValueError(
+                f"fqav_by={self.fqav_by} does not divide nfft={self.nfft}"
+            )
         self._coeffs = jnp.asarray(pfb_coeffs(self.ntap, self.nfft, self.window))
 
     @property
@@ -110,18 +121,27 @@ class RawReducer:
         )
 
     # -- core streaming ---------------------------------------------------
+    @property
+    def _channelize_kw(self) -> Dict:
+        """The exact channelize kwarg set (jax.jit caches per call
+        signature, so the kwarg set must be bit-stable across callers —
+        fqav_by only appears when active, keeping the common-case cache
+        signature identical to callers that never heard of it, bench.py
+        included)."""
+        kw = dict(
+            nfft=self.nfft, ntap=self.ntap, nint=self.nint,
+            stokes=self.stokes, fft_method=self.fft_method,
+        )
+        if self.fqav_by > 1:
+            kw["fqav_by"] = self.fqav_by
+        return kw
+
     def _run_chunk(self, chunk: np.ndarray) -> np.ndarray:
         import jax
 
         with self.timeline.stage("device", nbytes=chunk.nbytes):
             out = channelize(
-                jax.numpy.asarray(chunk),
-                self._coeffs,
-                nfft=self.nfft,
-                ntap=self.ntap,
-                nint=self.nint,
-                stokes=self.stokes,
-                fft_method=self.fft_method,
+                jax.numpy.asarray(chunk), self._coeffs, **self._channelize_kw
             )
             out = np.asarray(jax.block_until_ready(out))
         return out
@@ -227,13 +247,8 @@ class RawReducer:
                 stable = chunk.copy()
                 with self.timeline.stage("device", nbytes=stable.nbytes):
                     out = channelize(
-                        jax.numpy.asarray(stable),
-                        self._coeffs,
-                        nfft=self.nfft,
-                        ntap=self.ntap,
-                        nint=self.nint,
-                        stokes=self.stokes,
-                        fft_method=self.fft_method,
+                        jax.numpy.asarray(stable), self._coeffs,
+                        **self._channelize_kw,
                     )
                     sums.append(jnp.sum(out))
                 self._output_frames += frames
@@ -241,9 +256,20 @@ class RawReducer:
 
     # -- whole-file conveniences ------------------------------------------
     def header_for(self, raw: GuppiRaw) -> Dict:
-        return output_header(
+        hdr = output_header(
             raw.header(0), nfft=self.nfft, nint=self.nint, stokes=self.stokes
         )
+        if self.fqav_by > 1:
+            from blit.ops.fqav import fqav_range
+
+            fch1, foff, nchans = fqav_range(
+                hdr["fch1"], hdr["foff"], hdr["nchans"], self.fqav_by
+            )
+            hdr.update(
+                fch1=fch1, foff=foff, nchans=nchans,
+                nfpc=self.nfft // self.fqav_by,
+            )
+        return hdr
 
     def reduce(self, raw_src: RawSource) -> Tuple[Dict, np.ndarray]:
         """Reduce a whole RAW file — or a whole multi-file ``.NNNN.raw``
@@ -252,13 +278,16 @@ class RawReducer:
         raw = open_raw(raw_src)
         if raw.nblocks == 0:
             raise ValueError(f"empty or fully truncated RAW file: {raw.path}")
+        hdr = self.header_for(raw)
         slabs = list(self.stream(raw))
         if slabs:
             data = np.concatenate(slabs, axis=0)
         else:
-            nchan = raw.header(0)["OBSNCHAN"]
-            data = np.zeros((0, STOKES_NIF[self.stokes], nchan * self.nfft), np.float32)
-        hdr = self.header_for(raw)
+            # Zero usable frames: shape the empty product off the header so
+            # the channel axis stays consistent (fqav_by included).
+            data = np.zeros(
+                (0, STOKES_NIF[self.stokes], hdr["nchans"]), np.float32
+            )
         hdr["nsamps"] = data.shape[0]
         return hdr, data
 
@@ -317,6 +346,7 @@ class RawReducer:
             cur = ReductionCursor(
                 paths, self.nfft, self.ntap, self.nint, self.stokes, 0,
                 window=self.window, raw_size=size, raw_mtime_ns=mtime_ns,
+                fqav_by=self.fqav_by,
             )
             cur.save(out_path)
 
@@ -380,6 +410,7 @@ class ReductionCursor:
     window: str = "hamming"
     raw_size: Union[int, List[int]] = -1
     raw_mtime_ns: Union[int, List[int]] = -1
+    fqav_by: int = 1
 
     @staticmethod
     def stat_raw(raw_path: Union[str, Sequence[str]]) -> Tuple:
@@ -431,6 +462,7 @@ class ReductionCursor:
             and self.nint == red.nint
             and self.stokes == red.stokes
             and self.window == red.window
+            and self.fqav_by == red.fqav_by
             and norm(self.raw_size) == norm(size)
             and norm(self.raw_mtime_ns) == norm(mtime_ns)
         )

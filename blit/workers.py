@@ -13,6 +13,7 @@ sanitized to length-1 slices so results are always 3-D (reference:
 
 from __future__ import annotations
 
+import functools
 from typing import Callable, Dict, Optional, Tuple
 
 import numpy as np
@@ -103,11 +104,30 @@ def get_data(
     return reader(path, idxs, fqav_by=fqav_by, fqav_func=fqav_func)
 
 
-def get_kurtosis(path: str, idxs: Idxs = _ALL) -> np.ndarray:
+@functools.cache
+def _kurtosis_jit():
+    """The jitted on-device kurtosis kernel (built lazily: importing jax —
+    and holding a chip — only when a worker asks for device statistics)."""
+    import jax
+
+    return jax.jit(functools.partial(_kurtosis, axis=0))
+
+
+def get_kurtosis(path: str, idxs: Idxs = _ALL, device: bool = False) -> np.ndarray:
     """Excess kurtosis over time per (chan, pol), full time resolution
     (reference: src/gbtworkerfunctions.jl:197-202).  Returns shape
-    ``(nchan, nifs)`` to preserve the reference's ``[chan, if]`` indexing."""
+    ``(nchan, nifs)`` to preserve the reference's ``[chan, if]`` indexing.
+
+    ``device=True`` runs the moment reduction on the accelerator under jit
+    (SURVEY.md §2.2 StatsBase → "JAX moment kernels") — the reference's
+    "ship the computation, return the reduced statistic" lever (§3.4), with
+    only the tiny (nchan, nifs) map crossing back from the chip.
+    """
     data = get_data(path, idxs)
+    if device:
+        import jax.numpy as jnp
+
+        return np.asarray(_kurtosis_jit()(jnp.asarray(data))).T
     return np.asarray(_kurtosis(data, axis=0)).T
 
 

@@ -105,6 +105,54 @@ class TestChannelize:
         assert got.shape == want.shape == (2, ch.STOKES_NIF[stokes], 3 * nfft)
         np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-2)
 
+    def test_fqav_epilogue_matches_host_fqav(self):
+        # On-device frequency averaging == host fqav of the full product
+        # (the reduce-before-the-wire lever moved into the jitted kernel).
+        from blit.ops.fqav import fqav
+
+        nfft, ntap, nint, by = 64, 4, 1, 8
+        v = make_voltages(nchan=2, ntime=(ntap - 1 + 3) * nfft)
+        h = ch.pfb_coeffs(ntap, nfft)
+        got = np.asarray(
+            ch.channelize(
+                jnp.asarray(v), jnp.asarray(h), nfft=nfft, ntap=ntap,
+                nint=nint, fqav_by=by,
+            )
+        )
+        full = np.asarray(
+            ch.channelize(
+                jnp.asarray(v), jnp.asarray(h), nfft=nfft, ntap=ntap, nint=nint
+            )
+        )
+        assert got.shape == (3, 1, 2 * nfft // by)
+        np.testing.assert_allclose(got, fqav(full, by), rtol=1e-5, atol=1e-2)
+
+    def test_fqav_epilogue_through_reducer(self, tmp_path):
+        # RawReducer(fqav_by=): product + header shrink together.
+        from blit.ops.fqav import fqav
+        from blit.pipeline import RawReducer
+        from blit.testing import synth_raw
+
+        p = str(tmp_path / "x.raw")
+        synth_raw(p, nblocks=2, obsnchan=2, ntime_per_block=1024, tone_chan=1)
+        hdr, data = RawReducer(nfft=64, nint=2, fqav_by=4).reduce(p)
+        fhdr, full = RawReducer(nfft=64, nint=2).reduce(p)
+        assert hdr["nchans"] == fhdr["nchans"] // 4 == data.shape[-1]
+        assert hdr["foff"] == pytest.approx(fhdr["foff"] * 4)
+        assert hdr["nfpc"] == 64 // 4
+        np.testing.assert_allclose(data, fqav(full, 4), rtol=1e-5, atol=1e-2)
+
+    def test_fqav_must_divide_nfft(self, tmp_path):
+        # Averaging groups must not straddle coarse-channel boundaries.
+        from blit.pipeline import RawReducer
+
+        with pytest.raises(ValueError, match="divide nfft"):
+            RawReducer(nfft=64, fqav_by=48)
+        v = make_voltages(nchan=3, ntime=4 * 64)  # 3*64 divisible by 48
+        h = ch.pfb_coeffs(4, 64)
+        with pytest.raises(ValueError, match="divide nfft"):
+            ch.channelize(jnp.asarray(v), jnp.asarray(h), nfft=64, fqav_by=48)
+
     def test_tone_lands_in_right_fine_channel(self):
         nfft = 128
         v = make_voltages(nchan=2, ntime=8 * nfft, tone=(1, 96), nfft=nfft, seed=5)

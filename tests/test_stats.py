@@ -28,3 +28,51 @@ def test_kurtosis_constant_plus_spike():
     # A distribution with heavy tails has positive excess kurtosis.
     x = np.concatenate([np.zeros(999), [100.0]])
     assert kurtosis(x[:, None, None])[0, 0] > 100
+
+
+class TestDeviceKurtosis:
+    """On-device statistics (SURVEY.md §2.2 StatsBase → JAX moment kernels):
+    the same kernel jitted on the accelerator, golden vs host NumPy."""
+
+    def _fil(self, tmp_path):
+        import pytest
+
+        pytest.importorskip("jax")
+        from blit.testing import synth_fil
+
+        p = str(tmp_path / "k.fil")
+        synth_fil(p, nsamps=512, nifs=2, nchans=16, seed=3)
+        return p
+
+    def test_device_matches_host(self, tmp_path):
+        from blit import workers
+
+        p = self._fil(tmp_path)
+        host = workers.get_kurtosis(p)
+        dev = workers.get_kurtosis(p, device=True)
+        assert dev.shape == host.shape == (16, 2)
+        # Same float32 moment arithmetic modulo summation order.
+        np.testing.assert_allclose(dev, host, rtol=2e-3, atol=2e-3)
+
+    def test_device_with_idxs(self, tmp_path):
+        from blit import workers
+
+        p = self._fil(tmp_path)
+        host = workers.get_kurtosis(p, (slice(0, 256), 0, slice(None)))
+        dev = workers.get_kurtosis(
+            p, (slice(0, 256), 0, slice(None)), device=True
+        )
+        assert dev.shape == (16, 1)
+        np.testing.assert_allclose(dev, host, rtol=2e-3, atol=2e-3)
+
+    def test_pool_fanout_device(self, tmp_path):
+        from blit import gbt
+        from blit.parallel.pool import WorkerPool
+
+        p = self._fil(tmp_path)
+        pool = WorkerPool(["h0", "h1"], backend="local")
+        try:
+            maps = gbt.get_kurtosis([1, 2], [p, p], device=True, pool=pool)
+            np.testing.assert_allclose(maps[0], maps[1])
+        finally:
+            pool.shutdown()
