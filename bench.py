@@ -47,24 +47,30 @@ REALTIME_BANK_GBPS = 0.750
 # compiled shape (chunk_frames == its frames_per_call, same nchan → jit
 # cache hit, steady-state timing) and (b) the file length leaves exactly the
 # (ntap-1)*nfft filter tail after the last chunk, so no flush-shape compile
-# triggers.
+# triggers (total samples = n_chunks*frames*nfft + 3*nfft).
 _INGEST_CONFIGS = {
+    "tpu_bf16": (1 << 20, 32, 8, 4, 19 * (1 << 18)),
     "tpu": (1 << 20, 32, 5, 4, 13 * (1 << 18)),
     "tpu_small": (1 << 20, 16, 3, 4, 3 * (1 << 20)),
     "cpu": (1 << 14, 4, 4, 4, 11 * (1 << 12)),
 }
 
-# (nfft, ntap, nint, nchan, frames, K calls)
+# (nfft, ntap, nint, nchan, frames, K calls, dtype)
 _CONFIGS = {
-    # Hi-res product, sized to HBM: 32 coarse channels x 5 frames of
-    # 2^20-point channelization per dispatch (671 MB net per call;
-    # measured 4.4 GB/s = 5.8x real-time on a v5e chip).
-    "tpu": (1 << 20, 4, 1, 32, 5, 8),
+    # Hi-res product with bf16 DFT stages: halving the inter-stage HBM
+    # residents (DESIGN.md §8) fits 8 frames/dispatch where f32 OOMs at 8
+    # — more per-call work at the same dispatch overhead, and each stage
+    # moves half the bytes.  Accuracy bound: DESIGN.md §8.
+    "tpu_bf16": (1 << 20, 4, 1, 32, 8, 8, "bfloat16"),
+    # f32 flat-layout config: 32 coarse channels x 5 frames of 2^20-point
+    # channelization per dispatch (671 MB net per call; measured 4.4 GB/s
+    # = 5.8x real-time on a v5e chip in round 2).
+    "tpu": (1 << 20, 4, 1, 32, 5, 8, "float32"),
     # Fallback under repeated failures: same hi-res metric, half the
     # working set per dispatch.
-    "tpu_small": (1 << 20, 4, 1, 16, 3, 8),
+    "tpu_small": (1 << 20, 4, 1, 16, 3, 8, "float32"),
     # Dev machines (CPU): keep runtime sane.
-    "cpu": (1 << 14, 4, 1, 4, 4, 4),
+    "cpu": (1 << 14, 4, 1, 4, 4, 4, "float32"),
 }
 
 _ATTEMPTS_PER_CONFIG = 3
@@ -80,7 +86,7 @@ def run_single(config_name: str) -> None:
     from blit.ops.channelize import channelize, pfb_coeffs
 
     backend = jax.default_backend()
-    nfft, ntap, nint, nchan, frames, K = _CONFIGS[config_name]
+    nfft, ntap, nint, nchan, frames, K, dtype = _CONFIGS[config_name]
 
     ntime = (ntap - 1 + frames) * nfft
     rng = np.random.default_rng(0)
@@ -88,15 +94,17 @@ def run_single(config_name: str) -> None:
     coeffs = jnp.asarray(pfb_coeffs(ntap, nfft))
     vj = jax.block_until_ready(jnp.asarray(v))
 
+    # NOTE: the kwarg set here matches RawReducer._channelize_kw EXACTLY
+    # (jax.jit caches per call signature, so an extra/missing kwarg — even
+    # at its default value — forces a recompile and would poison the ingest
+    # leg's warm-cache assumption).  RawReducer adds dtype= only when not
+    # float32; mirror that.
+    kw = dict(nfft=nfft, ntap=ntap, nint=nint, stokes="I", fft_method="auto")
+    if dtype != "float32":
+        kw["dtype"] = dtype
+
     def step(x):
-        # NOTE: the kwarg set here matches RawReducer's channelize call
-        # EXACTLY (jax.jit caches per call signature, so an extra/missing
-        # kwarg — even at its default value — forces a recompile and would
-        # poison the ingest leg's warm-cache assumption).
-        out = channelize(
-            x, coeffs, nfft=nfft, ntap=ntap, nint=nint, stokes="I",
-            fft_method="auto",
-        )
+        out = channelize(x, coeffs, **kw)
         # Tiny on-device reduction: forces execution while keeping the
         # sync payload scalar (the tunnel's host readback is not the DUT).
         return jnp.sum(out)
@@ -132,10 +140,15 @@ def run_single(config_name: str) -> None:
             "frames_per_call": frames,
             "calls": K,
             "stokes": "I",
+            "dtype": dtype,
             "checksum": total,
         },
     }
     result.update(ingest)
+    try:
+        result.update(_run_config1())
+    except Exception as e:  # noqa: BLE001 — secondary metric must not kill the line
+        result["config1_error"] = f"{type(e).__name__}: {e}"
     print(json.dumps(result))
 
 
@@ -152,6 +165,7 @@ def _run_ingest(config_name: str) -> dict:
     from blit.testing import make_raw_header
 
     nfft, nchan, chunk_frames, nblocks, ntime = _INGEST_CONFIGS[config_name]
+    dtype = _CONFIGS[config_name][6]
     rng = np.random.default_rng(1)
     tmp = tempfile.mkdtemp(
         dir="/dev/shm" if os.path.isdir("/dev/shm") else None
@@ -169,9 +183,24 @@ def _run_ingest(config_name: str) -> dict:
         # BLIT_BENCH_TRACE=<logdir> wraps the streaming run in a JAX
         # profiler trace (TensorBoard/Perfetto) without touching the metric.
         red = RawReducer(nfft=nfft, nint=1, stokes="I",
-                         chunk_frames=chunk_frames,
+                         chunk_frames=chunk_frames, dtype=dtype,
                          trace_logdir=os.environ.get("BLIT_BENCH_TRACE") or None)
         raw = GuppiRaw(path)
+        # Producer-only read pass FIRST: measures the host read leg clean of
+        # device/tunnel interference (best of 2 — the shared single-vCPU rig
+        # has noisy-neighbor variance), and doubles as steady-state warmup
+        # (page cache + buffer first-touch faults) for the timed run below,
+        # matching the compute leg's compile warmup.
+        host_read_gbps = 0.0
+        for _ in range(2):
+            t0 = time.perf_counter()
+            for c in red._chunks(raw):
+                c.release()
+            host_read_gbps = max(
+                host_read_gbps,
+                file_bytes / (time.perf_counter() - t0) / 1e9,
+            )
+        red.timeline.stages.clear()  # warmup passes don't belong in stages
         t0 = time.perf_counter()
         checksum = red.drain(raw)
         elapsed = time.perf_counter() - t0
@@ -195,6 +224,9 @@ def _run_ingest(config_name: str) -> dict:
                 "nfft": nfft,
                 "nchan": nchan,
                 "chunk_frames": chunk_frames,
+                "dtype": dtype,
+                "prefetch_depth": red.prefetch_depth,
+                "host_read_gbps": round(host_read_gbps, 3),
                 "file_bytes": file_bytes,
                 "out_frames": red.stats.output_frames,
                 "checksum": checksum,
@@ -205,6 +237,60 @@ def _run_ingest(config_name: str) -> dict:
                     k: {"s": round(v.seconds, 3), "bytes": v.bytes}
                     for k, v in red.timeline.stages.items()
                 },
+            },
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _run_config1() -> dict:
+    """BASELINE config 1: single-bank ``0002.h5`` read → integrated power
+    spectrum — the reference's core read path (worker ``getdata`` +
+    post-read ``fqav``, src/gbtworkerfunctions.jl:179-189) over a
+    bitshuffle-compressed FBH5 file on a ram-backed dir.  Host-side only;
+    reported as GB/s of decompressed filterbank payload."""
+    import os
+    import shutil
+    import tempfile
+
+    from blit import workers
+    from blit.io.bshuf import available as bshuf_available
+    from blit.io.fbh5 import write_fbh5
+    from blit.testing import make_fil_header, make_spectra
+
+    nsamps, nifs, nchans, fqav_by = 256, 1, 1 << 20, 16
+    compression = "bitshuffle" if bshuf_available() else None
+    tmp = tempfile.mkdtemp(
+        dir="/dev/shm" if os.path.isdir("/dev/shm") else None
+    )
+    try:
+        path = os.path.join(tmp, "bench.rawspec.0002.h5")
+        hdr = make_fil_header(nchans=nchans, nifs=nifs, foff=-187.5 / nchans)
+        hdr["nfpc"] = nchans // 64
+        data = make_spectra(nsamps, nifs, nchans, seed=2)
+        write_fbh5(path, hdr, data, compression=compression,
+                   chunks=(nsamps, nifs, nchans // 64))
+        payload = data.nbytes
+
+        # Warm the reader once (h5py/libhdf5 init), then time the measured
+        # read: full-file hyperslab read + worker-side fqav to the
+        # integrated spectrum (the bytes that would otherwise cross the
+        # wire shrink by fqav_by).
+        workers.get_data(path, (slice(0, 1), slice(None), slice(None)))
+        t0 = time.perf_counter()
+        spec = workers.get_data(path, fqav_by=fqav_by)
+        elapsed = time.perf_counter() - t0
+        assert spec.shape == (nsamps, nifs, nchans // fqav_by)
+        return {
+            "config1_gbps": round(payload / elapsed / 1e9, 3),
+            "config1_config": {
+                "nsamps": nsamps,
+                "nifs": nifs,
+                "nchans": nchans,
+                "fqav_by": fqav_by,
+                "payload_bytes": payload,
+                "compression": compression or "none",
+                "checksum": float(spec.sum()),
             },
         }
     finally:
@@ -238,9 +324,9 @@ def main() -> int:
     if backend == "cpu":
         config_names = ["cpu"]
     elif backend in ("tpu", "axon"):
-        config_names = ["tpu", "tpu_small"]
+        config_names = ["tpu_bf16", "tpu", "tpu_small"]
     else:
-        config_names = ["tpu", "tpu_small", "cpu"]
+        config_names = ["tpu_bf16", "tpu", "tpu_small", "cpu"]
 
     last_err = "no attempts ran"
     for config_name in config_names:

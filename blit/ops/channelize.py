@@ -355,12 +355,22 @@ def channelize(
         # coarse-channel boundaries, corrupting nfpc-keyed consumers.
         raise ValueError(f"fqav_by={fqav_by} does not divide nfft={nfft}")
 
+    # bf16 mode applies from dequantization on: the int8 voltages carry 8
+    # significant bits, exactly bf16's mantissa, so the dequant planes and
+    # the 4-tap PFB lose nothing material in half-width — and the f32
+    # dequant/PFB intermediates were the peak-HBM residents that capped
+    # frames-per-dispatch (the gross (ntap-1+frames)/frames factor makes
+    # them BIGGER than the DFT intermediates).  Accuracy is pinned by
+    # tests/test_channelize.py::test_bfloat16_stage_dtype_close_to_golden.
+    work_dtype = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+    wcoeffs = shifted_coeffs.astype(work_dtype)
+
     def core(v):
-        re, im = dequantize(v)  # (cb, ntime, npol) each
+        re, im = dequantize(v, dtype=work_dtype)  # (cb, ntime, npol) each
         re = jnp.moveaxis(re, -1, 1)  # (cb, npol, ntime)
         im = jnp.moveaxis(im, -1, 1)
-        fr = pfb_frontend(re, shifted_coeffs)  # (cb, npol, nframes, nfft)
-        fi = pfb_frontend(im, shifted_coeffs)
+        fr = pfb_frontend(re, wcoeffs)  # (cb, npol, nframes, nfft)
+        fi = pfb_frontend(im, wcoeffs)
         sr, si = fft_planar(
             fr, fi, method=fft_method, precision=prec, dtype=dtype
         )
@@ -389,6 +399,41 @@ def channelize(
     if fqav_by > 1:
         out = _fqav(out, fqav_by)
     return out
+
+
+def channelize_blocked(
+    voltages,
+    coeffs,
+    *,
+    channel_block: int,
+    **kw,
+) -> jax.Array:
+    """Host-looped channel blocking: the compile-friendly replacement for
+    ``channelize(channel_block=)``'s in-jit ``lax.map`` (whose XLA loop
+    blows compile time past 500 s at nfft=2^20, DESIGN.md §3/§9).
+
+    Dispatches :func:`channelize` once per ``channel_block``-sized group of
+    coarse channels — ONE jit compile (group shape is constant), dispatches
+    enqueued async back-to-back, device-side concatenation of the per-group
+    products.  Peak HBM is bounded by one group's intermediates plus the
+    final product, so the per-*call* net work can grow well past what the
+    flat layout fits (the dispatch-amortization lever of DESIGN.md §3 at
+    bounded memory, now at seconds-scale compile).
+
+    Same result as ``channelize(..., channel_block=0)`` (golden-tested).
+    """
+    nchan = voltages.shape[0]
+    if channel_block <= 0 or channel_block >= nchan:
+        return channelize(voltages, coeffs, **kw)
+    if nchan % channel_block:
+        raise ValueError(
+            f"channel_block={channel_block} does not divide nchan={nchan}"
+        )
+    outs = [
+        channelize(voltages[c : c + channel_block], coeffs, **kw)
+        for c in range(0, nchan, channel_block)
+    ]
+    return jnp.concatenate(outs, axis=-1)
 
 
 def channelize_np(

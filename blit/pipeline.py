@@ -13,19 +13,29 @@ Design:
 - Every chunk handed to the device has the same static shape, so XLA compiles
   the reduction exactly once and the steady state is pure streaming.
 - A chunk of ``chunk_frames + ntap - 1`` gross blocks of ``nfft`` samples
-  yields ``chunk_frames`` PFB frames; the buffer then advances by
-  ``chunk_frames * nfft`` samples, keeping ``(ntap-1) * nfft`` as filter
-  state — frame continuity across chunks is exact (golden-tested against a
-  whole-file reduction).
+  yields ``chunk_frames`` PFB frames; consecutive chunks share a
+  ``(ntap-1) * nfft``-sample filter-state overlap — frame continuity across
+  chunks is exact (golden-tested against a whole-file reduction).
 - ``chunk_frames`` is a multiple of ``nint`` so integration never straddles a
   chunk boundary.  Trailing samples that can't fill an integration are
   dropped, as rawspec does.
+- Ingest is PIPELINED: a producer thread fills a rotation of
+  ``prefetch_depth`` stable chunk buffers straight from the file (native
+  threaded pread per block when built) while the device works on earlier
+  chunks.  Each buffer's first ``(ntap-1)*nfft`` samples are memcpy'd from
+  the previous buffer's tail (the filter state); every other byte is read
+  from disk exactly once, directly into its final position — no ring
+  shifting, and no per-chunk stabilization copy before dispatch (the
+  buffers themselves are stable until released).
 """
 
 from __future__ import annotations
 
 import logging
 import os
+import queue
+import threading
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple, Union
 
@@ -59,6 +69,25 @@ class ReductionStats:
         return self.input_bytes / self.wall_seconds / 1e9 if self.wall_seconds else 0.0
 
 
+class _Chunk:
+    """A filled chunk buffer handed to the consumer.  ``view`` aliases the
+    rotation buffer; it stays valid until :meth:`release`, after which the
+    producer may refill it."""
+
+    __slots__ = ("view", "frames", "_idx", "_free")
+
+    def __init__(self, view: np.ndarray, frames: int, idx: int, free) -> None:
+        self.view = view
+        self.frames = frames
+        self._idx = idx
+        self._free = free
+
+    def release(self) -> None:
+        if self._free is not None:
+            free, self._free = self._free, None
+            free(self._idx)
+
+
 @dataclass
 class RawReducer:
     """Configured RAW → filterbank reduction (one worker / one chip).
@@ -79,6 +108,15 @@ class RawReducer:
     # reduce-before-the-wire lever, src/gbtworkerfunctions.jl:16-20, moved
     # into the jitted kernel).  Headers carry the fqav_range mapping.
     fqav_by: int = 1
+    # Chunk buffers in the ingest rotation (>= 2).  2 = classic double
+    # buffering: the producer thread reads chunk i+1 from the file while the
+    # device works on chunk i.  Host memory held: prefetch_depth chunk-sized
+    # int8 buffers.
+    prefetch_depth: int = 2
+    # Working dtype of the channelizer's DFT stages ("float32"|"bfloat16").
+    # bf16 halves the inter-stage HBM, fitting ~2x the frames per dispatch
+    # at a measured accuracy cost (DESIGN.md §8).
+    dtype: str = "float32"
     # Output frames per device call; rounded up to a multiple of nint.
     chunk_frames: Optional[int] = None
     # Per-stage timing/byte registry ("ingest" / "device" / "stream").
@@ -91,6 +129,11 @@ class RawReducer:
         import jax.numpy as jnp
 
         self._output_frames = 0
+        # Chunk-buffer cache: streams on the same reducer reuse (already
+        # page-faulted) rotation buffers — first-touch faults on GB-sized
+        # buffers otherwise dominate short runs.  One stream at a time per
+        # reducer instance.
+        self._buf_cache: List[np.ndarray] = []
 
         if self.chunk_frames is None:
             # Budget-driven default: ~8M samples per coarse channel per device
@@ -134,6 +177,8 @@ class RawReducer:
         )
         if self.fqav_by > 1:
             kw["fqav_by"] = self.fqav_by
+        if self.dtype != "float32":
+            kw["dtype"] = self.dtype
         return kw
 
     def _run_chunk(self, chunk: np.ndarray) -> np.ndarray:
@@ -155,31 +200,54 @@ class RawReducer:
         skipping that many samples reproduces the remaining frames
         bit-identically (the resume path of :meth:`reduce_resumable`).
 
-        Ingest buffering is a preallocated ring: each block is read (via the
-        native threaded pread when built — ``GuppiRaw.read_block_into``)
-        straight into the ring at its time offset, with no per-block
-        re-concatenation of the whole buffer; after each chunk the
-        ``(ntap-1)*nfft``-sample filter state plus any residue shifts down
-        in place.
+        While chunk ``i`` computes and reads back, the producer thread is
+        already filling the next chunk buffer from the file (module
+        docstring: pipelined ingest).
         """
         with profile_trace(self.trace_logdir):
-            for chunk, frames in self._chunks(raw, skip_frames):
-                yield self._run_chunk(chunk)
-                self._output_frames += frames
+            for chunk in self._chunks(raw, skip_frames):
+                try:
+                    out = self._run_chunk(chunk.view)
+                finally:
+                    chunk.release()
+                self._output_frames += chunk.frames
+                yield out
 
-    def _chunks(
-        self, raw: GuppiRaw, skip_frames: int = 0
-    ) -> Iterator[Tuple[np.ndarray, int]]:
-        """The ring-buffered chunker behind :meth:`stream` / :meth:`drain`:
-        yields ``(chunk_view, frames)`` pairs.  The view aliases the ring and
-        is only valid until the next iteration."""
+    def _producer(
+        self,
+        raw: GuppiRaw,
+        skip_frames: int,
+        bufs: List[Optional[np.ndarray]],
+        free_q: "queue.Queue[int]",
+        filled_q: "queue.Queue",
+        stop: threading.Event,
+    ) -> None:
+        """Fill the chunk-buffer rotation from the file (producer thread).
+
+        Buffer ``j``'s first ``(ntap-1)*nfft`` samples are the filter state,
+        copied from the previously filled buffer's tail (which the consumer
+        may still be reading — concurrent reads are fine; a buffer is only
+        *refilled* after its consumer released it).  Everything else is read
+        from disk exactly once, directly into place.
+        """
         nfft, ntap, nint = self.nfft, self.ntap, self.nint
         chunk_samps = (self.chunk_frames + ntap - 1) * nfft
         advance = self.chunk_frames * nfft
+        state = (ntap - 1) * nfft
         to_skip = skip_frames * nfft
-        ring: Optional[np.ndarray] = None
-        filled = 0
-        with self.timeline.stage("stream"):
+
+        def acquire() -> Optional[int]:
+            while not stop.is_set():
+                try:
+                    return free_q.get(timeout=0.2)
+                except queue.Empty:
+                    continue
+            return None
+
+        try:
+            cur: Optional[int] = None
+            prev: Optional[int] = None
+            filled = 0
             for i in range(raw.nblocks):
                 hdr = raw.header(i)
                 nt = raw.block_ntime_kept(i)
@@ -190,69 +258,138 @@ class RawReducer:
                 to_skip = 0
                 nchan = hdr["OBSNCHAN"]
                 npol = 2 if hdr["NPOL"] > 2 else hdr["NPOL"]
-                with self.timeline.stage("ingest", nbytes=nchan * nt * npol * 2):
-                    if ring is None:
-                        cap = chunk_samps + nt
-                        ring = np.empty((nchan, cap, npol, 2), np.int8)
-                    elif filled + nt > ring.shape[1]:
-                        # Variable block sizes (rare): grow, preserving state.
-                        cap = max(2 * ring.shape[1], filled + nt)
-                        bigger = np.empty(
-                            (ring.shape[0], cap) + ring.shape[2:], np.int8
+                while nt > 0:
+                    if cur is None:
+                        # Waiting for a free buffer is back-pressure from
+                        # the device, NOT ingest work — keep it outside the
+                        # "ingest" stage so the timeline's GB/s is the true
+                        # host read rate.
+                        cur = acquire()
+                        if cur is None:
+                            return  # consumer abandoned the stream
+                        if bufs[cur] is None:
+                            shape = (nchan, chunk_samps, npol, 2)
+                            for j, b in enumerate(self._buf_cache):
+                                if b.shape == shape:
+                                    bufs[cur] = self._buf_cache.pop(j)
+                                    break
+                            else:
+                                bufs[cur] = np.empty(shape, np.int8)
+                        if prev is not None:
+                            # Separate stage: filter-state memcpy between
+                            # buffers is not file ingest ("ingest" bytes
+                            # must stay == file bytes for ReductionStats).
+                            state_bytes = nchan * state * npol * 2
+                            with self.timeline.stage("state",
+                                                     nbytes=state_bytes):
+                                bufs[cur][:, :state] = bufs[prev][:, advance:]
+                            filled = state
+                        else:
+                            filled = 0
+                    take = min(nt, chunk_samps - filled)
+                    with self.timeline.stage(
+                        "ingest", nbytes=nchan * take * npol * 2
+                    ):
+                        raw.read_block_into(
+                            i, bufs[cur][:, filled:], t0=t0, ntime_keep=take
                         )
-                        bigger[:, :filled] = ring[:, :filled]
-                        ring = bigger
-                    raw.read_block_into(
-                        i, ring[:, filled:], t0=t0, ntime_keep=nt
-                    )
-                    filled += nt
-                while filled >= chunk_samps:
-                    yield ring[:, :chunk_samps], self.chunk_frames
-                    filled -= advance
-                    # In-place shift of filter state + residue (numpy
-                    # guarantees overlapping same-array assignment copies
-                    # as-if through a temporary).
-                    ring[:, :filled] = ring[:, advance : advance + filled]
-            if ring is not None and filled > 0:
+                    filled += take
+                    t0 += take
+                    nt -= take
+                    if filled == chunk_samps:
+                        filled_q.put((cur, self.chunk_frames, chunk_samps))
+                        prev, cur = cur, None
+            if cur is not None and filled > (state if prev is not None else 0):
                 # Flush: whole frames remaining, rounded to the integration.
                 frames = usable_frames(filled, nfft, ntap, nint)
                 if frames > 0:
-                    yield ring[:, : (frames + ntap - 1) * nfft], frames
+                    filled_q.put((cur, frames, (frames + ntap - 1) * nfft))
+            filled_q.put(None)
+        except BaseException as e:  # noqa: BLE001 — forwarded to the consumer
+            filled_q.put(("error", e))
+
+    def _chunks(
+        self, raw: GuppiRaw, skip_frames: int = 0
+    ) -> Iterator["_Chunk"]:
+        """The pipelined chunker behind :meth:`stream` / :meth:`drain`:
+        yields :class:`_Chunk` handles in stream order.  The caller MUST
+        ``release()`` every chunk once nothing (host or device) still reads
+        its buffer; the producer blocks on released buffers to read ahead.
+        """
+        nbufs = max(2, self.prefetch_depth)
+        bufs: List[Optional[np.ndarray]] = [None] * nbufs
+        free_q: "queue.Queue[int]" = queue.Queue()
+        for j in range(nbufs):
+            free_q.put(j)
+        filled_q: "queue.Queue" = queue.Queue()
+        stop = threading.Event()
+        t = threading.Thread(
+            target=self._producer,
+            args=(raw, skip_frames, bufs, free_q, filled_q, stop),
+            name="blit-ingest",
+            daemon=True,
+        )
+        with self.timeline.stage("stream"):
+            t.start()
+            try:
+                while True:
+                    item = filled_q.get()
+                    if item is None:
+                        break
+                    if isinstance(item, tuple) and item[0] == "error":
+                        raise item[1]
+                    idx, frames, samps = item
+                    yield _Chunk(
+                        bufs[idx][:, :samps], frames, idx, free_q.put
+                    )
+            finally:
+                stop.set()
+                t.join()
+                # Keep the (faulted) buffers for the next stream.
+                self._buf_cache = [b for b in bufs if b is not None][:nbufs]
 
     def drain(self, raw: GuppiRaw) -> float:
         """Run the full streaming reduction with a device-side sink: each
         chunk's product reduces to a scalar checksum on device and only the
         final float crosses back.
 
-        Nothing synchronizes per chunk, so host block reads, host→device
-        transfers and device compute overlap through JAX's async dispatch —
+        Dispatch is async with a lag-synchronized window: chunk ``i``'s
+        scalar is synced (and its buffer released back to the producer) only
+        once ``prefetch_depth - 1`` newer chunks are in flight, so host
+        block reads, host→device transfers and device compute overlap —
         this is the steady-state shape of the ingest path, and the
         throughput probe for rigs whose device→host link is not
         representative (e.g. the dev tunnel's ~10 MB/s readback,
-        DESIGN.md §8).  Returns the checksum (sum over all products).
+        DESIGN.md §8).  No stabilization copy is needed: the chunk buffers
+        themselves stay untouched until released.  Returns the checksum
+        (sum over all products).
         """
         import jax
         import jax.numpy as jnp
 
-        # The final float() sync must happen INSIDE the trace context, or
-        # the profiler stops before the queued tail of the async work it
-        # exists to capture.
+        # The final syncs must happen INSIDE the trace context, or the
+        # profiler stops before the queued tail of the async work it exists
+        # to capture.
         with profile_trace(self.trace_logdir):
-            sums = []
-            for chunk, frames in self._chunks(raw):
-                # The view aliases the ring, which mutates after this
-                # iteration; device_put's host-side read time is not
-                # guaranteed, so hand JAX a stable copy before the async
-                # dispatch.
-                stable = chunk.copy()
-                with self.timeline.stage("device", nbytes=stable.nbytes):
+            total = 0.0
+            pending: deque = deque()
+            for chunk in self._chunks(raw):
+                with self.timeline.stage("device", nbytes=chunk.view.nbytes):
                     out = channelize(
-                        jax.numpy.asarray(stable), self._coeffs,
+                        jax.numpy.asarray(chunk.view), self._coeffs,
                         **self._channelize_kw,
                     )
-                    sums.append(jnp.sum(out))
-                self._output_frames += frames
-            return float(sum(float(s) for s in sums)) if sums else 0.0
+                    pending.append((chunk, jnp.sum(out)))
+                self._output_frames += chunk.frames
+                while len(pending) >= max(2, self.prefetch_depth):
+                    done, s = pending.popleft()
+                    total += float(s)  # sync: device is done with the input
+                    done.release()
+            while pending:
+                done, s = pending.popleft()
+                total += float(s)
+                done.release()
+            return total
 
     # -- whole-file conveniences ------------------------------------------
     def header_for(self, raw: GuppiRaw) -> Dict:
@@ -346,7 +483,7 @@ class RawReducer:
             cur = ReductionCursor(
                 paths, self.nfft, self.ntap, self.nint, self.stokes, 0,
                 window=self.window, raw_size=size, raw_mtime_ns=mtime_ns,
-                fqav_by=self.fqav_by,
+                fqav_by=self.fqav_by, dtype=self.dtype,
             )
             cur.save(out_path)
 
@@ -411,6 +548,7 @@ class ReductionCursor:
     raw_size: Union[int, List[int]] = -1
     raw_mtime_ns: Union[int, List[int]] = -1
     fqav_by: int = 1
+    dtype: str = "float32"
 
     @staticmethod
     def stat_raw(raw_path: Union[str, Sequence[str]]) -> Tuple:
@@ -463,6 +601,7 @@ class ReductionCursor:
             and self.stokes == red.stokes
             and self.window == red.window
             and self.fqav_by == red.fqav_by
+            and self.dtype == red.dtype
             and norm(self.raw_size) == norm(size)
             and norm(self.raw_mtime_ns) == norm(mtime_ns)
         )

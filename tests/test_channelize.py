@@ -142,6 +142,33 @@ class TestChannelize:
         assert hdr["nfpc"] == 64 // 4
         np.testing.assert_allclose(data, fqav(full, 4), rtol=1e-5, atol=1e-2)
 
+    def test_channelize_blocked_matches_flat(self):
+        # Host-looped channel blocking == flat single dispatch.
+        nfft, ntap = 64, 4
+        v = make_voltages(nchan=8, ntime=6 * nfft)
+        h = ch.pfb_coeffs(ntap, nfft)
+        flat = np.asarray(
+            ch.channelize(jnp.asarray(v), jnp.asarray(h), nfft=nfft, ntap=ntap)
+        )
+        blocked = np.asarray(
+            ch.channelize_blocked(
+                jnp.asarray(v), jnp.asarray(h), channel_block=2,
+                nfft=nfft, ntap=ntap,
+            )
+        )
+        np.testing.assert_array_equal(blocked, flat)
+        # Degenerate block sizes fall through to the flat path.
+        whole = np.asarray(
+            ch.channelize_blocked(
+                jnp.asarray(v), jnp.asarray(h), channel_block=8,
+                nfft=nfft, ntap=ntap,
+            )
+        )
+        np.testing.assert_array_equal(whole, flat)
+        with pytest.raises(ValueError, match="divide nchan"):
+            ch.channelize_blocked(jnp.asarray(v), jnp.asarray(h),
+                                  channel_block=3, nfft=nfft, ntap=ntap)
+
     def test_fqav_must_divide_nfft(self, tmp_path):
         # Averaging groups must not straddle coarse-channel boundaries.
         from blit.pipeline import RawReducer

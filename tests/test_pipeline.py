@@ -64,6 +64,42 @@ class TestStreaming:
         red = RawReducer(nfft=64, nint=6, chunk_frames=8)
         assert red.chunk_frames % 6 == 0
 
+    @pytest.mark.parametrize("depth", [2, 3, 4])
+    def test_prefetch_depth_invariant(self, tmp_path, depth):
+        # The rotation depth changes pipelining only — never the product.
+        p = str(tmp_path / "x.raw")
+        synth_raw(p, nblocks=4, obsnchan=2, ntime_per_block=1024,
+                  overlap=32, tone_chan=1)
+        base = RawReducer(nfft=64, nint=2, chunk_frames=4, prefetch_depth=2)
+        _, want = base.reduce(p)
+        red = RawReducer(nfft=64, nint=2, chunk_frames=4,
+                         prefetch_depth=depth)
+        _, got = red.reduce(p)
+        np.testing.assert_array_equal(got, want)
+        drained = RawReducer(nfft=64, nint=2, chunk_frames=4,
+                             prefetch_depth=depth).drain(GuppiRaw(p))
+        np.testing.assert_allclose(drained, float(want.sum()), rtol=1e-5)
+
+    def test_abandoned_stream_stops_producer(self, tmp_path):
+        # Breaking out of a stream must not leak a blocked ingest thread.
+        import threading
+
+        p = str(tmp_path / "x.raw")
+        synth_raw(p, nblocks=8, obsnchan=2, ntime_per_block=1024)
+        red = RawReducer(nfft=64, nint=1, chunk_frames=2)
+        it = red.stream(GuppiRaw(p))
+        next(it)
+        it.close()  # abandon mid-stream
+        for _ in range(50):
+            if not any(t.name == "blit-ingest" and t.is_alive()
+                       for t in threading.enumerate()):
+                break
+            import time
+
+            time.sleep(0.05)
+        assert not any(t.name == "blit-ingest" and t.is_alive()
+                       for t in threading.enumerate())
+
     def test_stats_track_input_bytes(self, tmp_path):
         p = str(tmp_path / "x.raw")
         _, blocks = synth_raw(p, nblocks=2, obsnchan=2, ntime_per_block=512)

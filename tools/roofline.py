@@ -1,0 +1,199 @@
+"""Per-stage roofline of one `channelize` call on the real chip.
+
+Times each pipeline stage separately under jit at the bench shapes and
+compares the achieved HBM bandwidth against the analytic minimum traffic
+(read every input once + write every output once).  The table this prints
+backs DESIGN.md §9 — the evidence for where the next optimization dollar
+goes (VERDICT round-2 "write the roofline, then attack it").
+
+Run on the TPU rig:  python tools/roofline.py [nchan frames [dtype]]
+
+Stages (f32 planar, factors (128, 128, 64) for nfft=2^20):
+  dequant+pfb   int8 → planar f32 frames (windowed sums)
+  dft1          128-pt DFT matmul + twiddle  (per recursion level 0)
+  dft2          128-pt DFT matmul + twiddle  (level 1)
+  dft3          64-pt DFT matmul             (level 2, innermost)
+  untwist2/1    swapaxes+reshape epilogues of levels 1 and 0
+  detect+int    |X|²+|Y|² detect (+ time integration) + product transpose
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from blit.ops import dft as D
+from blit.ops.channelize import dequantize, pfb_coeffs, pfb_frontend, detect_stokes_planar, integrate
+
+HBM_PEAK_GBPS = 819.0  # v5e spec number; the "roof"
+
+
+def timed(fn, *args, reps=4):
+    f = jax.jit(fn)
+    out = jax.block_until_ready(f(*args))  # compile
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = jax.block_until_ready(f(*args))
+    return (time.perf_counter() - t0) / reps, out
+
+
+def main() -> None:
+    nchan = int(sys.argv[1]) if len(sys.argv) > 1 else 32
+    frames = int(sys.argv[2]) if len(sys.argv) > 2 else 5
+    dtype = sys.argv[3] if len(sys.argv) > 3 else "float32"
+    nfft, ntap, npol = 1 << 20, 4, 2
+    ntime = (ntap - 1 + frames) * nfft
+    esize = 2 if dtype == "bfloat16" else 4
+
+    rng = np.random.default_rng(0)
+    v = rng.integers(-40, 40, (nchan, ntime, npol, 2), np.int8)
+    coeffs = jnp.asarray(pfb_coeffs(ntap, nfft))
+    vj = jax.block_until_ready(jnp.asarray(v))
+
+    # Planar complex element count of one full intermediate.
+    E = nchan * npol * frames * nfft
+    plane = E * esize  # bytes of ONE (re or im) plane
+    f32_plane = E * 4
+
+    rows = []
+
+    def row(name, seconds, rd, wr):
+        bts = rd + wr
+        rows.append((name, seconds, rd, wr, bts / seconds / 1e9))
+
+    # -- dequant + PFB (mirrors channelize: bf16 mode runs the whole stage
+    # half-width, from the dequant planes on) ------------------------------
+    work_dtype = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+    wcoeffs = coeffs.astype(work_dtype)
+
+    def s_pfb(x):
+        re, im = dequantize(x, dtype=work_dtype)
+        re = jnp.moveaxis(re, -1, 1)
+        im = jnp.moveaxis(im, -1, 1)
+        fr = pfb_frontend(re, wcoeffs)
+        fi = pfb_frontend(im, wcoeffs)
+        return fr, fi
+
+    t, (fr, fi) = timed(s_pfb, vj)
+    row("dequant+pfb", t, v.nbytes, 2 * plane)
+    frames_shape = fr.shape
+
+    # -- DFT stages, timed one recursion level at a time -------------------
+    factors = D.default_factors(nfft)
+    xr = jnp.reshape(fr, frames_shape[:-1] + (factors[0], nfft // factors[0]))
+    xi = jnp.reshape(fi, frames_shape[:-1] + (factors[0], nfft // factors[0]))
+
+    def stage_fn(n1, n2):
+        w1r, w1i = (jnp.asarray(a) for a in D.dft_matrices(n1, dtype))
+        tr, ti = (jnp.asarray(a) for a in D.twiddles(n1, n2, dtype))
+
+        def f(ar_, ai_):
+            a = jnp.einsum("kj,...jm->...km", w1r, ar_)
+            b = jnp.einsum("kj,...jm->...km", w1i, ar_)
+            c = jnp.einsum("kj,...jm->...km", w1r, ai_)
+            d = jnp.einsum("kj,...jm->...km", w1i, ai_)
+            sr, si = a - d, b + c
+            return sr * tr - si * ti, sr * ti + si * tr
+
+        return f
+
+    rest = nfft
+    level = 0
+    while len(D.default_factors(rest)) > 1:
+        n1 = D.default_factors(rest)[0]
+        n2 = rest // n1
+        t, (xr2, xi2) = timed(stage_fn(n1, n2), xr, xi)
+        row(f"dft{level + 1} (n1={n1})", t, 2 * plane, 2 * plane)
+        # reshape for the next level: rows stay batch, last axis splits again
+        nf = D.default_factors(n2)[0]
+        if len(D.default_factors(n2)) > 1:
+            xr = xr2.reshape(xr2.shape[:-1] + (nf, n2 // nf))
+            xi = xi2.reshape(xi2.shape[:-1] + (nf, n2 // nf))
+        else:
+            xr, xi = xr2, xi2
+        rest = n2
+        level += 1
+
+    wlast = rest
+
+    def last_fn(n):
+        wr, wi = (jnp.asarray(a) for a in D.dft_matrices(n, dtype))
+
+        def f(ar_, ai_):
+            a = jnp.matmul(ar_, wr)
+            b = jnp.matmul(ar_, wi)
+            c = jnp.matmul(ai_, wr)
+            d = jnp.matmul(ai_, wi)
+            return a - d, b + c
+
+        return f
+
+    t, (yr, yi) = timed(last_fn(wlast), xr, xi)
+    row(f"dft{level + 1} (n={wlast})", t, 2 * plane, 2 * plane)
+
+    # -- the untwist transposes (swapaxes + reshape per level) -------------
+    def untwist(ar_, ai_):
+        a = jnp.swapaxes(ar_, -1, -2)
+        b = jnp.swapaxes(ai_, -1, -2)
+        return jnp.ascontiguousarray(a), jnp.ascontiguousarray(b)
+
+    t, _ = timed(untwist, yr, yi)
+    row("untwist (x1 of 2)", t, 2 * plane, 2 * plane)
+
+    # -- detect + integrate + product transpose -----------------------------
+    sr = yr.reshape(frames_shape)
+    si = yi.reshape(frames_shape)
+
+    def s_detect(ar_, ai_):
+        if ar_.dtype != jnp.float32:
+            ar_, ai_ = ar_.astype(jnp.float32), ai_.astype(jnp.float32)
+        p = detect_stokes_planar(ar_, ai_, "I")
+        p = integrate(p, 1)
+        out = jnp.transpose(p, (2, 1, 0, 3))
+        return out.reshape(out.shape[0], out.shape[1], -1)
+
+    t, _ = timed(s_detect, sr, si)
+    row("detect+transpose", t, 2 * plane, f32_plane // npol)
+
+    # -- whole fused call for comparison ------------------------------------
+    from blit.ops.channelize import channelize
+
+    def whole(x):
+        return jnp.sum(channelize(x, coeffs, nfft=nfft, ntap=ntap, nint=1,
+                                  stokes="I", fft_method="auto",
+                                  **({} if dtype == "float32" else {"dtype": dtype})))
+
+    t0 = time.perf_counter()
+    jax.block_until_ready(whole(vj))
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    reps = 4
+    for _ in range(reps):
+        jax.block_until_ready(whole(vj))
+    whole_t = (time.perf_counter() - t0) / reps
+
+    net = frames * nfft * nchan * npol * 2  # int8 bytes credited by bench.py
+
+    print(f"\nroofline @ nchan={nchan} frames={frames} nfft=2^20 dtype={dtype}"
+          f"  (plane={plane / 1e9:.2f} GB, HBM peak {HBM_PEAK_GBPS:.0f} GB/s)")
+    print(f"{'stage':<20}{'ms':>9}{'rd GB':>8}{'wr GB':>8}{'GB/s':>9}{'%roof':>7}")
+    tot_ms = tot_bytes = 0.0
+    for name, s, rd, wr, gbps in rows:
+        n_un = 2 if name.startswith("untwist") else 1
+        tot_ms += s * 1e3 * n_un
+        tot_bytes += (rd + wr) * n_un
+        print(f"{name:<20}{s * 1e3:>9.1f}{rd / 1e9:>8.2f}{wr / 1e9:>8.2f}"
+              f"{gbps:>9.0f}{100 * gbps / HBM_PEAK_GBPS:>6.0f}%")
+    print(f"{'sum of stages':<20}{tot_ms:>9.1f}  (analytic min traffic "
+          f"{tot_bytes / 1e9:.1f} GB → {tot_bytes / HBM_PEAK_GBPS / 1e6:.1f} ms at roof)")
+    print(f"{'whole channelize':<20}{whole_t * 1e3:>9.1f}  net {net / 1e9:.3f} GB"
+          f" → {net / whole_t / 1e9:.2f} GB/s/chip  (compile {compile_s:.0f}s)")
+
+
+if __name__ == "__main__":
+    main()
