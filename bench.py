@@ -80,8 +80,23 @@ _ATTEMPT_TIMEOUT_S = 1500.0
 
 def run_single(config_name: str) -> None:
     """One measurement in this process; prints the JSON line on success."""
+    import os
+
     import jax
     import jax.numpy as jnp
+
+    # Persistent compilation cache: the 1M-point channelizer takes minutes
+    # to compile through the remote-compile tunnel; retries and re-runs (the
+    # orchestrator's fallback ladder, the driver's end-of-round run) hit the
+    # cache instead.  Verified effective on this backend.
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             ".jax_cache")
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:  # noqa: BLE001 — cache is an optimization, never fatal
+        pass
 
     from blit.ops.channelize import channelize, pfb_coeffs
 
@@ -165,7 +180,8 @@ def _run_ingest(config_name: str) -> dict:
     from blit.testing import make_raw_header
 
     nfft, nchan, chunk_frames, nblocks, ntime = _INGEST_CONFIGS[config_name]
-    dtype = _CONFIGS[config_name][6]
+    # Same working dtype as the primary leg (keeps the jit cache shared).
+    *_, dtype = _CONFIGS[config_name]
     rng = np.random.default_rng(1)
     tmp = tempfile.mkdtemp(
         dir="/dev/shm" if os.path.isdir("/dev/shm") else None

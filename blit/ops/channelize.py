@@ -155,6 +155,7 @@ def fft_planar(
     method: str = "auto",
     precision=None,
     dtype: str = "float32",
+    order: str = "natural",
 ) -> Tuple[jax.Array, jax.Array]:
     """Planar (re, im) FFT along the last axis — the dispatch point between
     the complex-dtype XLA paths and the TPU matmul-DFT path.
@@ -164,13 +165,20 @@ def fft_planar(
     — the lever that lets more frames fit per dispatch (DESIGN.md §3) — at
     a measured spectral accuracy cost comparable to the MXU's default
     bf16-grade multiplies (DESIGN.md §1).  Complex-FFT backends ignore it.
+
+    ``order="twisted"`` (matmul path only) skips the DFT's per-level
+    untwist transposes and emits the digit-permuted spectrum that
+    :func:`blit.ops.dft.untwist` restores — for order-oblivious consumers
+    (power detection) that can untwist their smaller output instead.
+    Complex-FFT methods always emit natural order.
     """
     method = resolve_fft_method(method, fr.shape[-1])
     if method == "matmul":
         if dtype != "float32":
             fr = fr.astype(dtype)
             fi = fi.astype(dtype)
-        return dftmod.dft(fr, fi, precision=precision, dtype=dtype)
+        return dftmod.dft(fr, fi, precision=precision, dtype=dtype,
+                          order=order)
     z = fft(jax.lax.complex(fr, fi), method=method)
     return jnp.real(z), jnp.imag(z)
 
@@ -273,7 +281,7 @@ def integrate(power: jax.Array, nint: int) -> jax.Array:
     jax.jit,
     static_argnames=(
         "nfft", "ntap", "nint", "stokes", "fft_method", "precision",
-        "channel_block", "dtype", "fqav_by",
+        "channel_block", "dtype", "fqav_by", "dft_order",
     ),
 )
 def channelize(
@@ -289,6 +297,7 @@ def channelize(
     channel_block: int = 0,
     dtype: str = "float32",
     fqav_by: int = 1,
+    dft_order: str = "auto",
 ) -> jax.Array:
     """The full single-chip reduction: int8 voltage block → filterbank slab.
 
@@ -310,12 +319,13 @@ def channelize(
         of this size via ``lax.map`` *inside* one device program — large
         per-dispatch work (amortizing dispatch latency) at bounded peak HBM
         (the hi-res 1M-point intermediates are what overflow otherwise).
-      dtype: working dtype of the FFT stages ("float32" | "bfloat16").
-        bfloat16 halves the HBM the inter-stage spectra occupy, fitting ~2x
-        the frames per dispatch; dequantization/PFB stay float32 and the
-        detected powers accumulate in float32 (the cast happens at the DFT
-        boundary, where the MXU truncates to bf16-grade products by default
-        anyway).  Measured accuracy: see DESIGN.md §1/§8.
+      dtype: working dtype from dequantization through the FFT stages
+        ("float32" | "bfloat16").  bfloat16 halves the HBM every
+        intermediate occupies — the f32 dequant/PFB planes were the peak
+        residents — fitting ~2x the frames per dispatch; int8 voltages
+        carry exactly bf16's 8 mantissa bits, and the detected powers
+        still accumulate in float32 (the MXU truncates matmul products to
+        bf16 grade by default anyway).  Measured accuracy: DESIGN.md §8.
       fqav_by: on-device frequency-averaging epilogue — sum every
         ``fqav_by`` consecutive fine channels (reference ``fqav`` default-f
         semantics, src/gbtworkerfunctions.jl:16-20) before anything leaves
@@ -365,6 +375,20 @@ def channelize(
     work_dtype = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
     wcoeffs = shifted_coeffs.astype(work_dtype)
 
+    # dft_order: "twisted" runs the matmul DFT in digit-permuted order
+    # (skipping its per-level transposes; detection is elementwise so the
+    # permutation rides through free) and untwists ONCE on the detected
+    # power.  Analytically that saves one full pass of traffic — but the
+    # interleaved A/B on the chip measured it ~20% SLOWER (4.08 vs
+    # 5.06 GB/s at the bf16 bench config): the reversed multi-axis power
+    # transpose lowers worse than the two spectra swapaxes XLA fuses.
+    # "auto" therefore = "natural"; the twisted path stays as a verified-
+    # correct tuning knob (see DESIGN.md §9).
+    if dft_order not in ("auto", "twisted", "natural"):
+        raise ValueError(f"bad dft_order {dft_order!r}")
+    resolved = resolve_fft_method(fft_method, nfft)
+    twisted = resolved == "matmul" and dft_order == "twisted"
+
     def core(v):
         re, im = dequantize(v, dtype=work_dtype)  # (cb, ntime, npol) each
         re = jnp.moveaxis(re, -1, 1)  # (cb, npol, ntime)
@@ -372,14 +396,18 @@ def channelize(
         fr = pfb_frontend(re, wcoeffs)  # (cb, npol, nframes, nfft)
         fi = pfb_frontend(im, wcoeffs)
         sr, si = fft_planar(
-            fr, fi, method=fft_method, precision=prec, dtype=dtype
+            fr, fi, method=fft_method, precision=prec, dtype=dtype,
+            order="twisted" if twisted else "natural",
         )
         if sr.dtype != jnp.float32:
             # Detect + integrate accumulate in f32 (the cast fuses into the
             # detect kernel; only the DFT intermediates stay half-width).
             sr, si = sr.astype(jnp.float32), si.astype(jnp.float32)
         power = detect_stokes_planar(sr, si, stokes)  # (cb, nif, frames, nfft)
-        return integrate(power, nint)  # (cb, nif, ntime_out, nfft)
+        power = integrate(power, nint)  # (cb, nif, ntime_out, nfft)
+        if twisted:
+            power = dftmod.untwist(power, dftmod.default_factors(nfft))
+        return power
 
     if channel_block and channel_block < nchan:
         if nchan % channel_block:

@@ -132,6 +132,23 @@ def _cmatmul_last(
 _PALLAS_MAX_N = 1024
 
 
+def untwist(x: jax.Array, factors: Tuple[int, ...]) -> jax.Array:
+    """Restore natural frequency order after ``dft(..., order="twisted")``.
+
+    The twisted-flat layout enumerates the per-level digit axes
+    ``(k1, k2, ..., klast)`` row-major, while the true frequency index is
+    ``k = k1 + f1*k2 + f1*f2*k3 + ...`` — so the untwist is one reshape /
+    reverse-axes transpose / reshape, a single materialized pass.
+    """
+    if len(factors) == 1:
+        return x
+    batch = x.shape[:-1]
+    nb = len(batch)
+    y = x.reshape(batch + tuple(factors))
+    perm = tuple(range(nb)) + tuple(reversed(range(nb, nb + len(factors))))
+    return jnp.transpose(y, perm).reshape(batch + (int(np.prod(factors)),))
+
+
 def dft(
     xr: jax.Array,
     xi: jax.Array,
@@ -140,6 +157,7 @@ def dft(
     dtype: str = "float32",
     factors: Optional[Tuple[int, ...]] = None,
     use_pallas: bool = False,
+    order: str = "natural",
 ) -> Planar:
     """Planar DFT along the last axis.
 
@@ -160,24 +178,33 @@ def dft(
     108 ms/call — XLA's own fusion already wins at these shapes, so the
     default is the XLA path; the kernels remain available (and correct on
     hardware, sum-checked) as the tuning surface for future tile-size work.
+    ``order``: ``"natural"`` emits true frequency order; ``"twisted"``
+    skips the per-level untwist transposes — the two materialized
+    HBM passes of the multi-level path — and emits the digit-permuted
+    layout that :func:`untwist` restores.  Order-oblivious consumers
+    (elementwise power detection) read the twisted spectra directly and
+    untwist once on their smaller output (the channelize fast path).
     """
     n = xr.shape[-1]
     if factors is None:
         factors = default_factors(n)
     if int(np.prod(factors)) != n:
         raise ValueError(f"dft: factors {factors} do not multiply to {n}")
+    if order not in ("natural", "twisted"):
+        raise ValueError(f"order must be 'natural' or 'twisted', got {order!r}")
     if use_pallas and dtype != "float32":
         # The kernels hardcode f32 tiles/accumulators (pallas_dft.py).
         raise ValueError("use_pallas supports dtype='float32' only")
     # Off-TPU, the kernels run in pallas interpreter mode (slow, correct) so
     # the flag is safe on every backend.
     interpret = jax.default_backend() not in ("tpu", "axon")
-    return _dft_rec(xr, xi, factors, precision, dtype, use_pallas, interpret)
+    return _dft_rec(xr, xi, factors, precision, dtype, use_pallas, interpret,
+                    order == "twisted")
 
 
 def _dft_rec(
     xr: jax.Array, xi: jax.Array, factors: Tuple[int, ...], precision, dtype,
-    use_pallas: bool = False, interpret: bool = False,
+    use_pallas: bool = False, interpret: bool = False, twisted: bool = False,
 ) -> Planar:
     n = xr.shape[-1]
     if len(factors) == 1:
@@ -214,7 +241,14 @@ def _dft_rec(
         ui = sr * ti + si * tr
     # Recurse: n2-point DFTs along the rows (last axis).
     vr, vi = _dft_rec(ur, ui, factors[1:], precision, dtype, use_pallas,
-                      interpret)
+                      interpret, twisted)
+    if twisted:
+        # Keep the (k1, <twisted n2>) layout: flatten row-major; the digit
+        # axes accumulate as (k1 of every level..., last k) — exactly what
+        # :func:`untwist` reverses.  No transpose pass at any level.
+        vr = vr.reshape(batch + (n,))
+        vi = vi.reshape(batch + (n,))
+        return vr, vi
     # Output index k = k1 + n1*k2: transpose (k1, k2) → (k2, k1) then flatten.
     vr = jnp.swapaxes(vr, -1, -2).reshape(batch + (n,))
     vi = jnp.swapaxes(vi, -1, -2).reshape(batch + (n,))
