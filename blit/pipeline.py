@@ -429,16 +429,46 @@ class RawReducer:
         return hdr, data
 
     def reduce_to_file(self, raw_src: RawSource, out_path: str) -> Dict:
-        """Reduce and write a ``.fil`` or (``.h5``) FBH5 product."""
-        hdr, data = self.reduce(raw_src)
+        """Reduce and write a ``.fil`` or (``.h5``) FBH5 product.
+
+        ``.fil`` products STREAM slab-by-slab to disk (SIGPROC derives
+        nsamps from file size, so append-only writing is exact) — host
+        memory stays at one slab regardless of scan length.  FBH5 output
+        materializes the product first (chunked/compressed layout needs
+        the whole array); use ``.fil`` for scans larger than RAM.
+        """
         if out_path.endswith((".h5", ".hdf5")):
             from blit.io.fbh5 import write_fbh5
 
+            hdr, data = self.reduce(raw_src)
             write_fbh5(out_path, hdr, data)
-        else:
-            from blit.io.sigproc import write_fil
+            return hdr
+        from blit.io.sigproc import write_fil
 
-            write_fil(out_path, hdr, data)
+        raw = open_raw(raw_src)
+        if raw.nblocks == 0:
+            raise ValueError(f"empty or fully truncated RAW file: {raw.path}")
+        hdr = self.header_for(raw)
+        nif = STOKES_NIF[self.stokes]
+        # Stream into a temp sibling and rename on success: SIGPROC derives
+        # nsamps from file size, so a crash mid-stream would otherwise leave
+        # a VALID-looking truncated product at out_path (silent data loss
+        # for consumers that treat existence as completion).  Resumable
+        # partial products are reduce_resumable's job — there the cursor
+        # sidecar marks incompleteness.
+        tmp_path = out_path + ".partial"
+        write_fil(tmp_path, hdr, np.zeros((0, nif, hdr["nchans"]), np.float32))
+        nsamps = 0
+        try:
+            with open(tmp_path, "ab") as f:
+                for slab in self.stream(raw):
+                    np.ascontiguousarray(slab).tofile(f)
+                    nsamps += slab.shape[0]
+            os.replace(tmp_path, out_path)
+        finally:
+            if os.path.exists(tmp_path):
+                os.unlink(tmp_path)
+        hdr["nsamps"] = nsamps
         return hdr
 
     def reduce_resumable(self, raw_src: RawSource, out_path: str) -> Dict:

@@ -75,6 +75,35 @@ class TestLoadScanMesh:
         span = abs(hdr["foff"]) * hdr["nchans"]
         assert span == pytest.approx(187.5)
 
+    def test_multifile_sequence_stems(self, tmp_path):
+        # Each player recorded as a 2-file .NNNN.raw sequence, passed as a
+        # bare stem: the mesh reduction must equal the same recording in
+        # one file per player (gap-free stitch across file boundaries).
+        from blit.io.guppi import write_raw
+        from blit.testing import make_raw_header, synth_raw_sequence
+
+        nbank, bank_bw = 4, -187.5 / 4
+        stems, monos = [], []
+        for k in range(nbank):
+            stem = str(tmp_path / f"seq{k}")
+            paths, stream = synth_raw_sequence(
+                stem, nfiles=2, blocks_per_file=1, obsnchan=2,
+                ntime_per_block=512, seed=k, tone_chan=k % 2,
+                obsbw=bank_bw, obsfreq=8000.0 + (k + 0.5) * bank_bw,
+            )
+            mono = str(tmp_path / f"mono{k}.raw")
+            write_raw(mono, make_raw_header(
+                obsnchan=2, obsbw=bank_bw,
+                obsfreq=8000.0 + (k + 0.5) * bank_bw), [stream])
+            stems.append(stem)
+            monos.append(mono)
+        _, out_seq = load_scan_mesh([stems], nfft=NFFT, nint=NINT,
+                                    despike=False)
+        _, out_mono = load_scan_mesh([monos], nfft=NFFT, nint=NINT,
+                                     despike=False)
+        np.testing.assert_array_equal(np.asarray(out_seq),
+                                      np.asarray(out_mono))
+
     def test_ragged_rejected(self, tmp_path):
         paths = make_scan(tmp_path, 1, 8)
         with pytest.raises(ValueError, match="rectangular"):

@@ -50,9 +50,11 @@ def timed(fn, *args, reps=6):
     float(g(*args))  # compile + settle
     t0 = time.perf_counter()
     acc = [g(*args) for _ in range(reps)]
-    total = sum(float(s) for s in acc)
-    per = (time.perf_counter() - t0 ) / reps
-    del total
+    # ONE fetch: the in-order queue means the last scalar materializing
+    # implies every rep executed; per-scalar fetches would charge each rep
+    # the ~100 ms tunnel round trip even for already-computed results.
+    float(acc[-1])
+    per = (time.perf_counter() - t0) / reps
     out = jax.jit(fn)(*args)
     return per, out
 
@@ -207,7 +209,7 @@ def main() -> None:
     t0 = time.perf_counter()
     reps = 4
     acc = [whole(vj) for _ in range(reps)]  # enqueue all, one latency charge
-    _ = sum(float(a) for a in acc)
+    float(acc[-1])
     whole_t = (time.perf_counter() - t0) / reps
 
     net = frames * nfft * nchan * npol * 2  # int8 bytes credited by bench.py
