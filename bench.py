@@ -137,7 +137,9 @@ def run_single(config_name: str) -> None:
     acc = [step(vj) for _ in range(K)]
     float(acc[-1])
     elapsed = time.perf_counter() - t0
-    total = sum(float(a) for a in acc)
+    # Checksum: one on-device sum + one fetch (K separate float()s would
+    # each pay the ~100 ms round trip).
+    total = float(jnp.sum(jnp.stack(acc)))
 
     net_bytes_per_call = frames * nfft * nchan * 2 * 2  # int8 re/im, 2 pol
     gbps = net_bytes_per_call * K / elapsed / 1e9

@@ -281,7 +281,7 @@ def integrate(power: jax.Array, nint: int) -> jax.Array:
     jax.jit,
     static_argnames=(
         "nfft", "ntap", "nint", "stokes", "fft_method", "precision",
-        "channel_block", "dtype", "fqav_by", "dft_order",
+        "channel_block", "dtype", "fqav_by", "dft_order", "pfb_kernel",
     ),
 )
 def channelize(
@@ -298,6 +298,7 @@ def channelize(
     dtype: str = "float32",
     fqav_by: int = 1,
     dft_order: str = "auto",
+    pfb_kernel: str = "auto",
 ) -> jax.Array:
     """The full single-chip reduction: int8 voltage block → filterbank slab.
 
@@ -389,12 +390,42 @@ def channelize(
     resolved = resolve_fft_method(fft_method, nfft)
     twisted = resolved == "matmul" and dft_order == "twisted"
 
+    # pfb_kernel: "pallas" fuses dequant + FIR into one VMEM-resident pass
+    # (blit/ops/pallas_pfb.py — the fix for the roofline's dominant stage,
+    # DESIGN.md §9): the int8 voltages are read once and the gross
+    # dequantized planes never exist in HBM.  Interleaved A/B on the chip:
+    # pallas 5.9-6.3 vs xla 4.86 GB/s end-to-end at the bf16 bench config,
+    # so "auto" = pallas on the matmul backends (the real chip) and the
+    # jnp path elsewhere (interpret-mode pallas is for tests only).  The
+    # kernel needs npol=2 int8 input; other shapes fall back.
+    if pfb_kernel not in ("auto", "xla", "pallas"):
+        raise ValueError(f"bad pfb_kernel {pfb_kernel!r}")
+    if pfb_kernel == "auto":
+        pfb_kernel = (
+            "pallas"
+            if jax.default_backend() in _MATMUL_ONLY_BACKENDS
+            else "xla"
+        )
+    use_pallas_pfb = (
+        pfb_kernel == "pallas"
+        and voltages.shape[2] == 2
+        and voltages.shape[3] == 2
+    )
+
     def core(v):
-        re, im = dequantize(v, dtype=work_dtype)  # (cb, ntime, npol) each
-        re = jnp.moveaxis(re, -1, 1)  # (cb, npol, ntime)
-        im = jnp.moveaxis(im, -1, 1)
-        fr = pfb_frontend(re, wcoeffs)  # (cb, npol, nframes, nfft)
-        fi = pfb_frontend(im, wcoeffs)
+        if use_pallas_pfb:
+            from blit.ops.pallas_pfb import pfb_dequant
+
+            fr, fi = pfb_dequant(
+                v, shifted_coeffs, dtype=dtype,
+                interpret=jax.default_backend() not in _MATMUL_ONLY_BACKENDS,
+            )
+        else:
+            re, im = dequantize(v, dtype=work_dtype)  # (cb, ntime, npol)
+            re = jnp.moveaxis(re, -1, 1)  # (cb, npol, ntime)
+            im = jnp.moveaxis(im, -1, 1)
+            fr = pfb_frontend(re, wcoeffs)  # (cb, npol, nframes, nfft)
+            fi = pfb_frontend(im, wcoeffs)
         sr, si = fft_planar(
             fr, fi, method=fft_method, precision=prec, dtype=dtype,
             order="twisted" if twisted else "natural",

@@ -1,0 +1,129 @@
+"""Pallas TPU kernel fusing dequantization + the polyphase FIR frontend.
+
+Why: the corrected roofline (DESIGN.md §9, tools/roofline.py) shows
+dequant+PFB is the channelizer's dominant stage — 90 ms at 64 GB/s (8% of
+the HBM roof) vs 25-29 ms at ~230 GB/s for each DFT matmul stage — because
+XLA materializes the dequantized gross planes and re-reads them once per
+tap, with the (chan, time, pol) → (chan, pol, time) transpose riding
+along.  This kernel does the whole stage in ONE pass: the int8 voltages
+enter VMEM exactly once (packed — each (npol=2, re/im) sample group is one
+int32 lane element, so the awkward size-2 minor axes never meet the lane
+dimension), bytes are sign-extended in-register, the ``ntap`` sign-folded
+window taps accumulate in f32, and the planar frame tensors stream out in
+the compute dtype.  HBM traffic drops from ~(2·gross·esize·ntap reads +
+2·plane writes) to (gross int8 read + 2·plane writes).
+
+Opt-in from :func:`blit.ops.channelize.channelize` via
+``pfb_kernel="pallas"``; CPU tests run in interpreter mode (golden vs the
+jnp path).  npol=2, NBITS=8 only — the GBT recording format
+(SURVEY.md §0); other shapes fall back to the jnp path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+# Fine-channel tile per kernel instance.  VMEM at the default: int32
+# (nblk, 8192) ≈ 11·8192·4 ≈ 360 KB in + 4 f32 gross planes ≈ 1.4 MB +
+# outputs — comfortably inside VMEM with room for double buffering.
+_DEF_TILE_J = 8192
+
+
+def _pick_tile(extent: int, target: int) -> int:
+    if extent <= target:
+        return extent
+    for t in range(target, 0, -1):
+        if extent % t == 0 and t % 128 == 0:
+            return t
+    for t in range(target, 0, -1):
+        if extent % t == 0:
+            return t
+    return 1
+
+
+def _kernel(nframes: int, ntap: int, out_dtype, v_ref, w_ref, or_ref, oi_ref):
+    x = v_ref[0]  # (nblk, tile_j) int32 — packed (p0r, p0i, p1r, p1i) bytes
+    w = w_ref[...]  # (ntap, tile_j) f32 (sign-folded window)
+
+    def byte(i: int) -> jax.Array:
+        # Little-endian byte i of each int32, sign-extended from int8.
+        return ((((x >> (8 * i)) & 0xFF) ^ 0x80) - 0x80).astype(jnp.float32)
+
+    def pfb(p: jax.Array) -> jax.Array:
+        # p: (nblk, tile_j) f32 → (nframes, tile_j): windowed tap sums.
+        acc = w[0] * p[0:nframes]
+        for k in range(1, ntap):
+            acc = acc + w[k] * p[k : k + nframes]
+        return acc.astype(out_dtype)
+
+    or_ref[0, 0] = pfb(byte(0))
+    oi_ref[0, 0] = pfb(byte(1))
+    or_ref[0, 1] = pfb(byte(2))
+    oi_ref[0, 1] = pfb(byte(3))
+
+
+def pfb_dequant(
+    voltages: jax.Array,
+    coeffs: jax.Array,
+    *,
+    dtype: str = "float32",
+    tile_j: int = _DEF_TILE_J,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """Fused int8 dequant + polyphase FIR, one HBM pass.
+
+    Args:
+      voltages: int8 ``(nchan, ntime, npol=2, 2)`` with ``ntime`` a
+        multiple of ``coeffs.shape[1]`` (GuppiRaw block layout).
+      coeffs: ``(ntap, nfft)`` float32 window (fftshift sign already
+        folded by the caller, as in :func:`channelize`).
+
+    Returns planar ``(fr, fi)`` shaped ``(nchan, npol, nframes, nfft)`` in
+    ``dtype`` — exactly ``pfb_frontend(moveaxis(dequantize(v)))``.
+    """
+    from jax.experimental import pallas as pl
+
+    nchan, ntime, npol, ncomp = voltages.shape
+    if npol != 2 or ncomp != 2:
+        raise ValueError("pfb_dequant: npol=2 complex int8 input required")
+    ntap, nfft = coeffs.shape
+    if ntime % nfft:
+        raise ValueError(f"ntime={ntime} not a multiple of nfft={nfft}")
+    nblk = ntime // nfft
+    nframes = nblk - ntap + 1
+    if nframes < 1:
+        raise ValueError(f"need >= {ntap} blocks of {nfft}, got {nblk}")
+
+    # Pack each sample's 4 int8 components into one int32 lane element —
+    # a pure bitcast of the contiguous buffer (no data movement).
+    packed = jax.lax.bitcast_convert_type(
+        voltages.reshape(nchan, nblk, nfft, npol * ncomp), jnp.int32
+    )  # (nchan, nblk, nfft)
+
+    tile_j = _pick_tile(nfft, tile_j)
+    grid = (nchan, nfft // tile_j)
+    out_dtype = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+    kern = functools.partial(_kernel, nframes, ntap, out_dtype)
+    out_shape = [
+        jax.ShapeDtypeStruct((nchan, npol, nframes, nfft), out_dtype),
+        jax.ShapeDtypeStruct((nchan, npol, nframes, nfft), out_dtype),
+    ]
+    fr, fi = pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, nblk, tile_j), lambda c, j: (c, 0, j)),
+            pl.BlockSpec((ntap, tile_j), lambda c, j: (0, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, npol, nframes, tile_j), lambda c, j: (c, 0, 0, j)),
+            pl.BlockSpec((1, npol, nframes, tile_j), lambda c, j: (c, 0, 0, j)),
+        ],
+        out_shape=out_shape,
+        interpret=interpret,
+    )(packed, coeffs)
+    return fr, fi

@@ -392,6 +392,15 @@ class RawReducer:
             return total
 
     # -- whole-file conveniences ------------------------------------------
+    def _open_validated(self, raw_src: RawSource):
+        """Shared prologue of every whole-recording entry point: open the
+        source, reject empty/truncated recordings, derive the product
+        header.  Returns ``(raw, header)``."""
+        raw = open_raw(raw_src)
+        if raw.nblocks == 0:
+            raise ValueError(f"empty or fully truncated RAW file: {raw.path}")
+        return raw, self.header_for(raw)
+
     def header_for(self, raw: GuppiRaw) -> Dict:
         hdr = output_header(
             raw.header(0), nfft=self.nfft, nint=self.nint, stokes=self.stokes
@@ -412,10 +421,7 @@ class RawReducer:
         """Reduce a whole RAW file — or a whole multi-file ``.NNNN.raw``
         scan sequence (path list / stem, blit/io/guppi.open_raw) — in memory
         → ``(filterbank_header, data)`` with data ``(nsamps, nif, nchans)``."""
-        raw = open_raw(raw_src)
-        if raw.nblocks == 0:
-            raise ValueError(f"empty or fully truncated RAW file: {raw.path}")
-        hdr = self.header_for(raw)
+        raw, hdr = self._open_validated(raw_src)
         slabs = list(self.stream(raw))
         if slabs:
             data = np.concatenate(slabs, axis=0)
@@ -445,10 +451,7 @@ class RawReducer:
             return hdr
         from blit.io.sigproc import write_fil
 
-        raw = open_raw(raw_src)
-        if raw.nblocks == 0:
-            raise ValueError(f"empty or fully truncated RAW file: {raw.path}")
-        hdr = self.header_for(raw)
+        raw, hdr = self._open_validated(raw_src)
         nif = STOKES_NIF[self.stokes]
         # Stream into a temp sibling and rename on success: SIGPROC derives
         # nsamps from file size, so a crash mid-stream would otherwise leave
@@ -488,13 +491,10 @@ class RawReducer:
             raise ValueError("reduce_resumable writes .fil (appendable) products")
         from blit.io.sigproc import read_fil_header, write_fil
 
-        raw = open_raw(raw_src)
-        if raw.nblocks == 0:
-            raise ValueError(f"empty or fully truncated RAW file: {raw.path}")
+        raw, hdr = self._open_validated(raw_src)
         # Cursor identity: the member path list (single files keep the plain
         # string so pre-existing sidecars stay valid).
         paths = getattr(raw, "paths", None) or raw.path
-        hdr = self.header_for(raw)
         nif = STOKES_NIF[self.stokes]
         spectrum_bytes = nif * hdr["nchans"] * 4  # float32 products
 
