@@ -49,6 +49,11 @@ REALTIME_BANK_GBPS = 0.750
 # (ntap-1)*nfft filter tail after the last chunk, so no flush-shape compile
 # triggers (total samples = n_chunks*frames*nfft + 3*nfft).
 _INGEST_CONFIGS = {
+    # Note: 32 channels, NOT the primary leg's 48 — the streaming drain
+    # holds two chunk inputs in flight on top of the compute intermediates,
+    # so the ingest leg needs more headroom than the device-resident
+    # primary (48-chunk drain OOMs); nchan differs → separate jit entry
+    # either way, and the 32x8 bf16 program is already cached.
     "tpu_bf16": (1 << 20, 32, 8, 4, 19 * (1 << 18)),
     "tpu": (1 << 20, 32, 5, 4, 13 * (1 << 18)),
     "tpu_small": (1 << 20, 16, 3, 4, 3 * (1 << 20)),
@@ -57,11 +62,11 @@ _INGEST_CONFIGS = {
 
 # (nfft, ntap, nint, nchan, frames, K calls, dtype)
 _CONFIGS = {
-    # Hi-res product with bf16 DFT stages: halving the inter-stage HBM
-    # residents (DESIGN.md §8) fits 8 frames/dispatch where f32 OOMs at 8
-    # — more per-call work at the same dispatch overhead, and each stage
-    # moves half the bytes.  Accuracy bound: DESIGN.md §8.
-    "tpu_bf16": (1 << 20, 4, 1, 32, 8, 8, "bfloat16"),
+    # Hi-res product, bf16 stages + fused pallas dequant+PFB: the gross
+    # dequant planes never hit HBM, so 48 coarse channels x 8 frames fit
+    # per dispatch (interleaved A/B: 48ch 6.2-6.4 vs 32ch 5.8-6.0 GB/s;
+    # 64ch OOMs).  Accuracy bound: DESIGN.md §8.
+    "tpu_bf16": (1 << 20, 4, 1, 48, 8, 8, "bfloat16"),
     # f32 flat-layout config: 32 coarse channels x 5 frames of 2^20-point
     # channelization per dispatch (671 MB net per call; measured 4.4 GB/s
     # = 5.8x real-time on a v5e chip in round 2).
@@ -140,6 +145,9 @@ def run_single(config_name: str) -> None:
     # Checksum: one on-device sum + one fetch (K separate float()s would
     # each pay the ~100 ms round trip).
     total = float(jnp.sum(jnp.stack(acc)))
+    # Free the primary leg's device residents (up to GBs) before the
+    # secondary legs — they have their own working sets and OOM otherwise.
+    del acc, vj
 
     net_bytes_per_call = frames * nfft * nchan * 2 * 2  # int8 re/im, 2 pol
     gbps = net_bytes_per_call * K / elapsed / 1e9

@@ -1,0 +1,138 @@
+"""Command-line interface: ``python -m blit <command>``.
+
+The reference is a library driven from the Julia REPL; the tool it
+replaces on the recording nodes — rawspec — is a CLI.  blit ships both:
+the library (:mod:`blit.gbt` et al.) and this thin command layer over it.
+
+Commands:
+  reduce     GUPPI RAW (file, .NNNN.raw sequence stem, or member list)
+             → filterbank product (.fil streams to disk; .h5 = FBH5).
+  inventory  Crawl a data tree (reference getinventory semantics) and
+             print records as JSON lines or a table.
+  info       Print the normalized header of a .fil / .h5 / .raw file.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+
+def _cmd_reduce(args: argparse.Namespace) -> int:
+    from blit.pipeline import PRODUCT_PRESETS, RawReducer, reducer_for_product
+
+    kw = dict(stokes=args.stokes, fqav_by=args.fqav, dtype=args.dtype)
+    if args.product is not None:
+        red = reducer_for_product(args.product, **kw)
+    else:
+        red = RawReducer(nfft=args.nfft, nint=args.nint, **kw)
+    src: object = args.raw[0] if len(args.raw) == 1 else args.raw
+    if args.resume:
+        hdr = red.reduce_resumable(src, args.output)
+    else:
+        hdr = red.reduce_to_file(src, args.output)
+    stats = red.stats
+    print(
+        json.dumps(
+            {
+                "output": args.output,
+                "nsamps": hdr.get("nsamps"),
+                "nchans": hdr.get("nchans"),
+                "nifs": hdr.get("nifs"),
+                "input_bytes": stats.input_bytes,
+                "gbps": round(stats.gbps, 3),
+            }
+        )
+    )
+    return 0
+
+
+def _cmd_inventory(args: argparse.Namespace) -> int:
+    from blit.inventory import get_inventory, raw_sequences
+
+    records = get_inventory(
+        args.file_re,
+        root=args.root,
+        session_re=args.session_re,
+        extra=args.extra,
+    )
+    if args.sequences:
+        for rec, paths in raw_sequences(records):
+            print(json.dumps({"stem_of": rec._asdict(), "files": paths}))
+        return 0
+    for rec in records:
+        print(json.dumps(rec._asdict()))
+    return 0
+
+
+def _cmd_info(args: argparse.Namespace) -> int:
+    path = args.file
+    if path.endswith(".raw") or _looks_like_raw(path):
+        from blit.io.guppi import open_raw
+
+        raw = open_raw(path)
+        hdr = dict(raw.header(0))
+        hdr["_nblocks"] = raw.nblocks
+        hdr["_files"] = getattr(raw, "paths", [raw.path])
+        hdr["_time_span_s"] = raw.time_span_s()
+    else:
+        from blit.workers import get_header
+
+        hdr = get_header(path)
+    print(json.dumps(hdr, indent=2, default=str))
+    return 0
+
+
+def _looks_like_raw(path: str) -> bool:
+    import os
+
+    from blit.io.guppi import scan_files
+
+    return not os.path.exists(path) and bool(scan_files(path))
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    from blit.pipeline import PRODUCT_PRESETS
+
+    p = argparse.ArgumentParser(prog="blit", description=__doc__)
+    sub = p.add_subparsers(dest="command", required=True)
+
+    pr = sub.add_parser("reduce", help="RAW → filterbank product")
+    pr.add_argument("raw", nargs="+",
+                    help="RAW file, .NNNN.raw sequence stem, or member list")
+    pr.add_argument("-o", "--output", required=True,
+                    help="output product path (.fil streams; .h5 = FBH5)")
+    pr.add_argument("--product", choices=sorted(PRODUCT_PRESETS),
+                    help="rawspec product preset (else --nfft/--nint)")
+    pr.add_argument("--nfft", type=int, default=1024)
+    pr.add_argument("--nint", type=int, default=1)
+    pr.add_argument("--stokes", default="I")
+    pr.add_argument("--fqav", type=int, default=1,
+                    help="on-device frequency averaging factor")
+    pr.add_argument("--dtype", default="float32",
+                    choices=["float32", "bfloat16"])
+    pr.add_argument("--resume", action="store_true",
+                    help="crash-resumable streaming (.fil only)")
+    pr.set_defaults(fn=_cmd_reduce)
+
+    pi = sub.add_parser("inventory", help="crawl a data tree")
+    pi.add_argument("root")
+    pi.add_argument("--file-re", default=None)
+    pi.add_argument("--session-re", default=None)
+    pi.add_argument("--extra", default=None)
+    pi.add_argument("--sequences", action="store_true",
+                    help="group .NNNN.raw members into scan sequences")
+    pi.set_defaults(fn=_cmd_inventory)
+
+    pf = sub.add_parser("info", help="print a file's normalized header")
+    pf.add_argument("file")
+    pf.set_defaults(fn=_cmd_info)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

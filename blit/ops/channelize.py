@@ -400,17 +400,38 @@ def channelize(
     # kernel needs npol=2 int8 input; other shapes fall back.
     if pfb_kernel not in ("auto", "xla", "pallas"):
         raise ValueError(f"bad pfb_kernel {pfb_kernel!r}")
+    backend = jax.default_backend()
+    pol_ok = voltages.shape[2] == 2 and voltages.shape[3] == 2
     if pfb_kernel == "auto":
+        from blit.ops import pallas_pfb
+
+        # Prefer the kernel only where it is compiled natively AND the
+        # shapes fit its VMEM budget (large-nframes chunks — e.g. the
+        # '0002' preset — exceed any fine tile and take the XLA path).
         pfb_kernel = (
             "pallas"
-            if jax.default_backend() in _MATMUL_ONLY_BACKENDS
+            if (
+                backend in _MATMUL_ONLY_BACKENDS
+                and pol_ok
+                and pallas_pfb.fits(
+                    nfft, voltages.shape[1] // nfft, ntap, dtype
+                )
+            )
             else "xla"
         )
-    use_pallas_pfb = (
-        pfb_kernel == "pallas"
-        and voltages.shape[2] == 2
-        and voltages.shape[3] == 2
-    )
+    elif pfb_kernel == "pallas":
+        if not pol_ok:
+            raise ValueError("pfb_kernel='pallas' needs npol=2 complex int8")
+        if backend not in _MATMUL_ONLY_BACKENDS and backend != "cpu":
+            # CPU runs the kernel interpreted (the test path); any other
+            # backend would silently interpret too — orders of magnitude
+            # slower than the XLA path, the opposite of what opting in
+            # asks for.
+            raise ValueError(
+                f"pfb_kernel='pallas' is not supported on backend "
+                f"{backend!r} (TPU compiles it; CPU interprets for tests)"
+            )
+    use_pallas_pfb = pfb_kernel == "pallas"
 
     def core(v):
         if use_pallas_pfb:
@@ -418,7 +439,7 @@ def channelize(
 
             fr, fi = pfb_dequant(
                 v, shifted_coeffs, dtype=dtype,
-                interpret=jax.default_backend() not in _MATMUL_ONLY_BACKENDS,
+                interpret=backend not in _MATMUL_ONLY_BACKENDS,
             )
         else:
             re, im = dequantize(v, dtype=work_dtype)  # (cb, ntime, npol)

@@ -27,22 +27,46 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
-# Fine-channel tile per kernel instance.  VMEM at the default: int32
-# (nblk, 8192) ≈ 11·8192·4 ≈ 360 KB in + 4 f32 gross planes ≈ 1.4 MB +
-# outputs — comfortably inside VMEM with room for double buffering.
+# Fine-channel tile per kernel instance (upper bound; shrunk until the
+# VMEM budget below holds).  At the bench shape (nblk=11): int32
+# (11, 8192) ≈ 360 KB in + 4 f32 gross planes ≈ 1.4 MB + outputs.
 _DEF_TILE_J = 8192
 
+# Per-instance VMEM budget (v5e has ~16 MB; leave room for double
+# buffering and the compiler's own scratch).
+_VMEM_BUDGET = 6 << 20
 
-def _pick_tile(extent: int, target: int) -> int:
-    if extent <= target:
-        return extent
-    for t in range(target, 0, -1):
-        if extent % t == 0 and t % 128 == 0:
+
+def _tile_bytes(tile_j: int, nblk: int, nframes: int, ntap: int,
+                esize: int) -> int:
+    """VMEM resident bytes for one kernel instance at fine-tile ``tile_j``:
+    packed int32 input + 4 decoded f32 gross planes + 2 output frame
+    planes + the coeff tile."""
+    return tile_j * (nblk * 4 + 4 * nblk * 4 + 2 * nframes * esize + ntap * 4)
+
+
+def pick_tile(nfft: int, nblk: int, nframes: int, ntap: int,
+              esize: int, target: int = _DEF_TILE_J) -> int:
+    """Largest usable divisor of ``nfft`` <= target whose instance fits
+    the VMEM budget; 0 if none — the caller falls back to the XLA path.
+    Usable = lane-aligned (multiple of 128) or the whole axis: sub-lane
+    tiles would technically fit VMEM but serialize the vector unit, which
+    is worse than not running the kernel at all."""
+    for t in range(min(target, nfft), 0, -1):
+        if nfft % t or (t % 128 and t != nfft):
+            continue
+        if _tile_bytes(t, nblk, nframes, ntap, esize) <= _VMEM_BUDGET:
             return t
-    for t in range(target, 0, -1):
-        if extent % t == 0:
-            return t
-    return 1
+    return 0
+
+
+def fits(nfft: int, nblk: int, ntap: int, dtype: str = "float32") -> bool:
+    """True when :func:`pfb_dequant` can run these shapes inside the VMEM
+    budget — the gate ``channelize(pfb_kernel="auto")`` uses before
+    preferring the kernel (e.g. the '0002' preset's 2048-frame chunks
+    exceed any fine tile and must take the XLA path)."""
+    esize = 2 if dtype == "bfloat16" else 4
+    return pick_tile(nfft, nblk, nblk - ntap + 1, ntap, esize) > 0
 
 
 def _kernel(nframes: int, ntap: int, out_dtype, v_ref, w_ref, or_ref, oi_ref):
@@ -97,14 +121,20 @@ def pfb_dequant(
     nframes = nblk - ntap + 1
     if nframes < 1:
         raise ValueError(f"need >= {ntap} blocks of {nfft}, got {nblk}")
+    esize = 2 if dtype == "bfloat16" else 4
+    tile_j = pick_tile(nfft, nblk, nframes, ntap, esize, tile_j)
+    if tile_j == 0:
+        raise ValueError(
+            f"pfb_dequant: no fine-channel tile of nfft={nfft} fits VMEM at "
+            f"{nblk} blocks ({nframes} frames) — use the XLA path "
+            f"(channelize pfb_kernel='xla'; 'auto' gates on pallas_pfb.fits)"
+        )
 
     # Pack each sample's 4 int8 components into one int32 lane element —
     # a pure bitcast of the contiguous buffer (no data movement).
     packed = jax.lax.bitcast_convert_type(
         voltages.reshape(nchan, nblk, nfft, npol * ncomp), jnp.int32
     )  # (nchan, nblk, nfft)
-
-    tile_j = _pick_tile(nfft, tile_j)
     grid = (nchan, nfft // tile_j)
     out_dtype = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
     kern = functools.partial(_kernel, nframes, ntap, out_dtype)

@@ -1,0 +1,79 @@
+"""CLI surface (python -m blit): reduce / inventory / info."""
+
+import json
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from blit.__main__ import main  # noqa: E402
+from blit.testing import build_observation_tree, synth_raw, synth_raw_sequence  # noqa: E402
+
+
+def run(capsys, *argv):
+    rc = main(list(argv))
+    out = capsys.readouterr().out
+    return rc, out
+
+
+class TestReduce:
+    def test_reduce_single_file(self, tmp_path, capsys):
+        raw = str(tmp_path / "x.raw")
+        synth_raw(raw, nblocks=2, obsnchan=2, ntime_per_block=1024,
+                  tone_chan=1)
+        out = str(tmp_path / "x.fil")
+        rc, txt = run(capsys, "reduce", raw, "-o", out, "--nfft", "64",
+                      "--nint", "2")
+        assert rc == 0
+        rep = json.loads(txt)
+        assert rep["output"] == out and rep["nsamps"] > 0
+        from blit.io.sigproc import read_fil_data
+
+        hdr, data = read_fil_data(out)
+        assert np.asarray(data).shape == (rep["nsamps"], 1, rep["nchans"])
+
+    def test_reduce_sequence_stem_resume(self, tmp_path, capsys):
+        stem = str(tmp_path / "seq")
+        synth_raw_sequence(stem, nfiles=2, blocks_per_file=1, obsnchan=2,
+                           ntime_per_block=1024)
+        out = str(tmp_path / "seq.fil")
+        rc, txt = run(capsys, "reduce", stem, "-o", out, "--nfft", "64",
+                      "--resume")
+        assert rc == 0 and json.loads(txt)["nsamps"] > 0
+
+    def test_reduce_product_preset(self, tmp_path, capsys):
+        raw = str(tmp_path / "p.raw")
+        synth_raw(raw, nblocks=2, obsnchan=2, ntime_per_block=4096)
+        out = str(tmp_path / "p.fil")
+        rc, txt = run(capsys, "reduce", raw, "-o", out, "--product", "0001")
+        assert rc == 0
+        assert json.loads(txt)["nchans"] == 2 * 8  # 0001: nfft=8
+
+
+class TestInventoryInfo:
+    def test_inventory_jsonl_and_sequences(self, tmp_path, capsys):
+        root = str(tmp_path / "datax")
+        build_observation_tree(root, kind="raw", players=((0, 0), (0, 1)))
+        rc, txt = run(capsys, "inventory", root, "--file-re", r"\.raw$")
+        assert rc == 0
+        recs = [json.loads(l) for l in txt.strip().splitlines()]
+        assert len(recs) == 2 and all(r["session"] for r in recs)
+        rc, txt = run(capsys, "inventory", root, "--file-re", r"\.raw$",
+                      "--sequences")
+        seqs = [json.loads(l) for l in txt.strip().splitlines()]
+        assert len(seqs) == 2 and all(len(s["files"]) == 1 for s in seqs)
+
+    def test_info_raw_and_fil(self, tmp_path, capsys):
+        raw = str(tmp_path / "i.raw")
+        synth_raw(raw, nblocks=3, obsnchan=4, ntime_per_block=256)
+        rc, txt = run(capsys, "info", raw)
+        hdr = json.loads(txt)
+        assert rc == 0 and hdr["OBSNCHAN"] == 4 and hdr["_nblocks"] == 3
+
+        from blit.testing import synth_fil
+
+        fil = str(tmp_path / "i.fil")
+        synth_fil(fil, nchans=8)
+        rc, txt = run(capsys, "info", fil)
+        assert rc == 0 and json.loads(txt)["nchans"] == 8

@@ -69,14 +69,18 @@ class TestPfbDequant:
                                      stokes="XXYY"))
         np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-2)
 
-    def test_single_pol_falls_back(self):
+    def test_single_pol_explicit_rejected_auto_falls_back(self):
         rng = np.random.default_rng(3)
         nfft = 64
         v = rng.integers(-40, 40, (2, 5 * nfft, 1, 2), np.int8)
         h = jnp.asarray(ch.pfb_coeffs(4, nfft))
-        a = np.asarray(ch.channelize(jnp.asarray(v), h, nfft=nfft,
-                                     pfb_kernel="pallas"))
-        b = np.asarray(ch.channelize(jnp.asarray(v), h, nfft=nfft))
+        # Explicit opt-in that cannot run must error, not silently degrade.
+        with pytest.raises(ValueError, match="npol=2"):
+            ch.channelize(jnp.asarray(v), h, nfft=nfft, pfb_kernel="pallas")
+        # "auto" quietly takes the XLA path for unsupported shapes.
+        a = np.asarray(ch.channelize(jnp.asarray(v), h, nfft=nfft))
+        b = np.asarray(ch.channelize(jnp.asarray(v), h, nfft=nfft,
+                                     pfb_kernel="xla"))
         np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-4)
 
     def test_bad_kernel_name_rejected(self):
@@ -84,3 +88,15 @@ class TestPfbDequant:
         h = jnp.asarray(ch.pfb_coeffs(4, 64))
         with pytest.raises(ValueError, match="pfb_kernel"):
             ch.channelize(v, h, nfft=64, pfb_kernel="cuda")
+
+    def test_vmem_gate(self):
+        from blit.ops import pallas_pfb as pp
+
+        # Bench shape fits; the '0002' preset's 2048-frame chunks do not.
+        assert pp.fits(1 << 20, 11, 4, "bfloat16")
+        assert not pp.fits(1 << 10, 2051, 4, "float32")
+        # And pfb_dequant refuses outright rather than failing in mosaic.
+        v = jnp.zeros((1, 2051 * 1024, 2, 2), jnp.int8)
+        h = jnp.asarray(ch.pfb_coeffs(4, 1024))
+        with pytest.raises(ValueError, match="VMEM"):
+            pp.pfb_dequant(v, h, interpret=True)
