@@ -21,7 +21,7 @@ from typing import List, Optional
 
 
 def _cmd_reduce(args: argparse.Namespace) -> int:
-    from blit.pipeline import PRODUCT_PRESETS, RawReducer, reducer_for_product
+    from blit.pipeline import RawReducer, reducer_for_product
 
     kw = dict(stokes=args.stokes, fqav_by=args.fqav, dtype=args.dtype)
     if args.product is not None:
@@ -93,9 +93,14 @@ def _looks_like_raw(path: str) -> bool:
     return not os.path.exists(path) and bool(scan_files(path))
 
 
-def main(argv: Optional[List[str]] = None) -> int:
-    from blit.pipeline import PRODUCT_PRESETS
+# rawspec's standard product presets (stable contract, mirrored from
+# blit.pipeline.PRODUCT_PRESETS — not imported here so `blit info` /
+# `blit inventory` never pay the jax import just to build --product
+# choices; tests/test_cli.py pins the two lists equal).
+_PRODUCTS = ("0000", "0001", "0002")
 
+
+def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(prog="blit", description=__doc__)
     sub = p.add_subparsers(dest="command", required=True)
 
@@ -104,7 +109,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="RAW file, .NNNN.raw sequence stem, or member list")
     pr.add_argument("-o", "--output", required=True,
                     help="output product path (.fil streams; .h5 = FBH5)")
-    pr.add_argument("--product", choices=sorted(PRODUCT_PRESETS),
+    pr.add_argument("--product", choices=list(_PRODUCTS),
                     help="rawspec product preset (else --nfft/--nint)")
     pr.add_argument("--nfft", type=int, default=1024)
     pr.add_argument("--nint", type=int, default=1)

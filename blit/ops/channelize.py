@@ -398,48 +398,98 @@ def channelize(
     # so "auto" = pallas on the matmul backends (the real chip) and the
     # jnp path elsewhere (interpret-mode pallas is for tests only).  The
     # kernel needs npol=2 int8 input; other shapes fall back.
-    if pfb_kernel not in ("auto", "xla", "pallas"):
+    if pfb_kernel not in ("auto", "xla", "pallas", "fused1"):
         raise ValueError(f"bad pfb_kernel {pfb_kernel!r}")
     backend = jax.default_backend()
     pol_ok = voltages.shape[2] == 2 and voltages.shape[3] == 2
     if pfb_kernel == "auto":
         from blit.ops import pallas_pfb
 
-        # Prefer the kernel only where it is compiled natively AND the
-        # shapes fit its VMEM budget (large-nframes chunks — e.g. the
-        # '0002' preset — exceed any fine tile and take the XLA path).
-        pfb_kernel = (
-            "pallas"
-            if (
-                backend in _MATMUL_ONLY_BACKENDS
-                and pol_ok
-                and pallas_pfb.fits(
-                    nfft, voltages.shape[1] // nfft, ntap, dtype
-                )
+        # Prefer the fullest fusion that compiles natively AND fits the
+        # VMEM budget: fused1 (dequant+PFB+DFT stage 1; interleaved A/B
+        # 8.3-8.7 vs 6.4 GB/s) → pallas (dequant+PFB) → xla.  Large-
+        # nframes chunks (e.g. the '0002' preset) exceed any fine tile
+        # and take the XLA path.
+        nblk = voltages.shape[1] // nfft
+        pfb_kernel = "xla"
+        if backend in _MATMUL_ONLY_BACKENDS and pol_ok:
+            # default_factors only inside the matmul guard: the FFT paths
+            # accept nfft values it cannot factor.
+            factors = (
+                dftmod.default_factors(nfft) if resolved == "matmul" else ()
             )
-            else "xla"
-        )
-    elif pfb_kernel == "pallas":
+            if (
+                len(factors) >= 2
+                and pallas_pfb.fused1_fits(
+                    nfft, nblk, ntap, factors[0], dtype
+                )
+            ):
+                pfb_kernel = "fused1"
+            elif pallas_pfb.fits(nfft, nblk, ntap, dtype):
+                pfb_kernel = "pallas"
+    elif pfb_kernel in ("pallas", "fused1"):
         if not pol_ok:
-            raise ValueError("pfb_kernel='pallas' needs npol=2 complex int8")
+            raise ValueError(
+                f"pfb_kernel={pfb_kernel!r} needs npol=2 complex int8"
+            )
         if backend not in _MATMUL_ONLY_BACKENDS and backend != "cpu":
             # CPU runs the kernel interpreted (the test path); any other
             # backend would silently interpret too — orders of magnitude
             # slower than the XLA path, the opposite of what opting in
             # asks for.
             raise ValueError(
-                f"pfb_kernel='pallas' is not supported on backend "
+                f"pfb_kernel={pfb_kernel!r} is not supported on backend "
                 f"{backend!r} (TPU compiles it; CPU interprets for tests)"
             )
+        if pfb_kernel == "fused1":
+            if resolved != "matmul":
+                raise ValueError(
+                    "pfb_kernel='fused1' fuses the matmul-DFT's first "
+                    "stage; it needs fft_method='matmul'"
+                )
+            if len(dftmod.default_factors(nfft)) < 2:
+                raise ValueError(
+                    "pfb_kernel='fused1' needs a multi-factor nfft "
+                    f"(> {dftmod.DIRECT_DFT_MAX})"
+                )
+            if twisted:
+                raise ValueError(
+                    "pfb_kernel='fused1' emits natural order; it does not "
+                    "combine with dft_order='twisted'"
+                )
     use_pallas_pfb = pfb_kernel == "pallas"
+    use_fused1 = pfb_kernel == "fused1"
+    interp = backend not in _MATMUL_ONLY_BACKENDS
 
     def core(v):
+        if use_fused1:
+            # dequant + PFB + DFT stage 1 in one pallas pass; the frame
+            # planes never hit HBM.  Remaining factors + natural-order
+            # assembly via dft_tail, then detect as usual.
+            from blit.ops.pallas_pfb import pfb_dft1
+
+            factors = dftmod.default_factors(nfft)
+            n1 = factors[0]
+            w1r, w1i = (jnp.asarray(a)
+                        for a in dftmod.dft_matrices(n1, "float32"))
+            t1r, t1i = (jnp.asarray(a)
+                        for a in dftmod.twiddles(n1, nfft // n1, "float32"))
+            ur, ui = pfb_dft1(
+                v, shifted_coeffs, w1r, w1i, t1r, t1i, dtype=dtype,
+                interpret=interp,
+            )
+            sr, si = dftmod.dft_tail(
+                ur, ui, factors, precision=prec, dtype=dtype
+            )
+            if sr.dtype != jnp.float32:
+                sr, si = sr.astype(jnp.float32), si.astype(jnp.float32)
+            power = detect_stokes_planar(sr, si, stokes)
+            return integrate(power, nint)
         if use_pallas_pfb:
             from blit.ops.pallas_pfb import pfb_dequant
 
             fr, fi = pfb_dequant(
-                v, shifted_coeffs, dtype=dtype,
-                interpret=backend not in _MATMUL_ONLY_BACKENDS,
+                v, shifted_coeffs, dtype=dtype, interpret=interp,
             )
         else:
             re, im = dequantize(v, dtype=work_dtype)  # (cb, ntime, npol)

@@ -255,6 +255,32 @@ def _dft_rec(
     return vr, vi
 
 
+def dft_tail(
+    ur: jax.Array,
+    ui: jax.Array,
+    factors: Tuple[int, ...],
+    *,
+    precision=None,
+    dtype: str = "float32",
+) -> Planar:
+    """Finish a DFT whose first stage (n1-point matmul + twiddle) was
+    computed externally — e.g. by the fused dequant+PFB+stage-1 pallas
+    kernel (blit/ops/pallas_pfb.pfb_dft1): run the remaining ``factors[1:]``
+    along the last axis and assemble natural frequency order.
+
+    ``ur, ui``: ``(..., n1, m)`` stage-1 outputs (twiddle already applied).
+    Returns ``(..., n1*m)`` natural-order spectra.
+    """
+    n1, m = ur.shape[-2], ur.shape[-1]
+    if factors[0] != n1 or int(np.prod(factors[1:])) != m:
+        raise ValueError(f"dft_tail: factors {factors} mismatch ({n1}, {m})")
+    vr, vi = _dft_rec(ur, ui, factors[1:], precision, dtype)
+    batch = ur.shape[:-2]
+    vr = jnp.swapaxes(vr, -1, -2).reshape(batch + (n1 * m,))
+    vi = jnp.swapaxes(vi, -1, -2).reshape(batch + (n1 * m,))
+    return vr, vi
+
+
 def dft_np(xr: np.ndarray, xi: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
     """NumPy golden reference (tests)."""
     z = np.fft.fft(xr + 1j * xi)

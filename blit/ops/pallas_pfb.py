@@ -28,9 +28,10 @@ import jax
 import jax.numpy as jnp
 
 # Fine-channel tile per kernel instance (upper bound; shrunk until the
-# VMEM budget below holds).  At the bench shape (nblk=11): int32
-# (11, 8192) ≈ 360 KB in + 4 f32 gross planes ≈ 1.4 MB + outputs.
-_DEF_TILE_J = 8192
+# VMEM budget below holds).  Swept on the chip at the production shape
+# (48ch × 8fr bf16): 2048 ≈ 86-90 ms, 4096 ≈ 89, 8192 ≈ 92-95,
+# 16384/32768 ≈ 94-95 — smaller tiles pipeline HBM↔VMEM better.
+_DEF_TILE_J = 4096
 
 # Per-instance VMEM budget (v5e has ~16 MB; leave room for double
 # buffering and the compiler's own scratch).
@@ -40,9 +41,11 @@ _VMEM_BUDGET = 6 << 20
 def _tile_bytes(tile_j: int, nblk: int, nframes: int, ntap: int,
                 esize: int) -> int:
     """VMEM resident bytes for one kernel instance at fine-tile ``tile_j``:
-    packed int32 input + 4 decoded f32 gross planes + 2 output frame
-    planes + the coeff tile."""
-    return tile_j * (nblk * 4 + 4 * nblk * 4 + 2 * nframes * esize + ntap * 4)
+    packed int32 input + 4 decoded f32 gross planes + 4 output frame
+    planes (re/im × 2 pols) + the coeff tile."""
+    return tile_j * (
+        nblk * 4 + 4 * nblk * 4 + 2 * 2 * nframes * esize + ntap * 4
+    )
 
 
 def pick_tile(nfft: int, nblk: int, nframes: int, ntap: int,
@@ -88,6 +91,150 @@ def _kernel(nframes: int, ntap: int, out_dtype, v_ref, w_ref, or_ref, oi_ref):
     oi_ref[0, 0] = pfb(byte(1))
     or_ref[0, 1] = pfb(byte(2))
     oi_ref[0, 1] = pfb(byte(3))
+
+
+def _fused1_kernel(nframes: int, ntap: int, n1: int, out_dtype,
+                   v_ref, w_ref, w1r_ref, w1i_ref, tr_ref, ti_ref,
+                   or_ref, oi_ref):
+    """dequant + PFB + DFT stage 1 (+twiddle), one VMEM pass.
+
+    Blocks (per grid instance, fine columns ``j2``-tiled):
+      v:   (1, nblk, n1, tile_m) int32  packed voltages
+      w:   (ntap, n1, tile_m)    f32    sign-folded window
+      w1:  (n1, n1)              f32    stage-1 DFT matrix (re, im)
+      tw:  (n1, tile_m)          f32    stage-1 twiddle (re, im)
+      out: (1, npol, nframes, n1, tile_m) out_dtype (re, im)
+    """
+    x = v_ref[0]  # (nblk, n1, tile_m) int32
+    w = w_ref[...]
+    w1r = w1r_ref[...]
+    w1i = w1i_ref[...]
+    tr = tr_ref[...]
+    ti = ti_ref[...]
+
+    def byte(i: int) -> jax.Array:
+        return ((((x >> (8 * i)) & 0xFF) ^ 0x80) - 0x80).astype(jnp.float32)
+
+    planes = (byte(0), byte(1), byte(2), byte(3))  # p0r p0i p1r p1i
+    for p in range(2):
+        re_g, im_g = planes[2 * p], planes[2 * p + 1]
+        for f in range(nframes):
+            fr = w[0] * re_g[f]
+            fi = w[0] * im_g[f]
+            for k in range(1, ntap):
+                fr = fr + w[k] * re_g[f + k]
+                fi = fi + w[k] * im_g[f + k]
+            # Stage-1 complex DFT down the n1 axis + twiddle.
+            rr = jnp.dot(w1r, fr, preferred_element_type=jnp.float32)
+            ii = jnp.dot(w1i, fi, preferred_element_type=jnp.float32)
+            ri = jnp.dot(w1r, fi, preferred_element_type=jnp.float32)
+            ir = jnp.dot(w1i, fr, preferred_element_type=jnp.float32)
+            sr = rr - ii
+            si = ri + ir
+            or_ref[0, p, f] = (sr * tr - si * ti).astype(out_dtype)
+            oi_ref[0, p, f] = (sr * ti + si * tr).astype(out_dtype)
+
+
+def fused1_fits(nfft: int, nblk: int, ntap: int, n1: int,
+                dtype: str = "float32") -> bool:
+    """VMEM-fit gate for :func:`pfb_dft1` (see :func:`_fused1_tile`)."""
+    return _fused1_tile(nfft, nblk, ntap, n1, dtype) > 0
+
+
+def _fused1_tile(nfft: int, nblk: int, ntap: int, n1: int,
+                 dtype: str, target: int = 512) -> int:
+    esize = 2 if dtype == "bfloat16" else 4
+    m = nfft // n1
+    nframes = nblk - ntap + 1
+    for t in range(min(target, m), 0, -1):
+        if m % t or (t % 128 and t != m):
+            continue
+        bts = t * (
+            nblk * n1 * 4          # packed input
+            + ntap * n1 * 4        # window
+            + 2 * n1 * 4           # twiddles
+            + 2 * 2 * nframes * n1 * esize  # outputs (2 planes x 2 pols)
+        ) + 2 * n1 * n1 * 4        # DFT matrices
+        if bts <= _VMEM_BUDGET:
+            return t
+    return 0
+
+
+def pfb_dft1(
+    voltages: jax.Array,
+    coeffs: jax.Array,
+    w1r: jax.Array,
+    w1i: jax.Array,
+    tr: jax.Array,
+    ti: jax.Array,
+    *,
+    dtype: str = "float32",
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """Fused dequant + PFB + first Cooley-Tukey DFT stage.
+
+    One HBM pass replaces three: the PFB frame planes never materialize —
+    int8 in, stage-1 spectra (twiddled, ready for the remaining factors of
+    :func:`blit.ops.dft._dft_rec`) out.
+
+    Args:
+      voltages: int8 ``(nchan, ntime, 2, 2)``.
+      coeffs: ``(ntap, nfft)`` f32 sign-folded window.
+      w1r, w1i: ``(n1, n1)`` stage-1 DFT matrix parts.
+      tr, ti: ``(n1, nfft//n1)`` stage-1 twiddle parts.
+
+    Returns ``(ur, ui)`` shaped ``(nchan, npol, nframes, n1, nfft//n1)``.
+    """
+    from jax.experimental import pallas as pl
+
+    nchan, ntime, npol, ncomp = voltages.shape
+    if npol != 2 or ncomp != 2:
+        raise ValueError("pfb_dft1: npol=2 complex int8 input required")
+    ntap, nfft = coeffs.shape
+    n1 = w1r.shape[0]
+    m = nfft // n1
+    if ntime % nfft:
+        raise ValueError(f"ntime={ntime} not a multiple of nfft={nfft}")
+    nblk = ntime // nfft
+    nframes = nblk - ntap + 1
+    tile_m = _fused1_tile(nfft, nblk, ntap, n1, dtype)
+    if tile_m == 0:
+        raise ValueError(
+            "pfb_dft1: no column tile fits VMEM at these shapes — use the "
+            "unfused path"
+        )
+
+    packed = jax.lax.bitcast_convert_type(
+        voltages.reshape(nchan, nblk, n1, m, npol * ncomp), jnp.int32
+    )  # (nchan, nblk, n1, m)
+    wv = coeffs.reshape(ntap, n1, m)
+    out_dtype = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+    kern = functools.partial(_fused1_kernel, nframes, ntap, n1, out_dtype)
+    out_shape = [
+        jax.ShapeDtypeStruct((nchan, npol, nframes, n1, m), out_dtype),
+        jax.ShapeDtypeStruct((nchan, npol, nframes, n1, m), out_dtype),
+    ]
+    ur, ui = pl.pallas_call(
+        kern,
+        grid=(nchan, m // tile_m),
+        in_specs=[
+            pl.BlockSpec((1, nblk, n1, tile_m), lambda c, j: (c, 0, 0, j)),
+            pl.BlockSpec((ntap, n1, tile_m), lambda c, j: (0, 0, j)),
+            pl.BlockSpec((n1, n1), lambda c, j: (0, 0)),
+            pl.BlockSpec((n1, n1), lambda c, j: (0, 0)),
+            pl.BlockSpec((n1, tile_m), lambda c, j: (0, j)),
+            pl.BlockSpec((n1, tile_m), lambda c, j: (0, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, npol, nframes, n1, tile_m),
+                         lambda c, j: (c, 0, 0, 0, j)),
+            pl.BlockSpec((1, npol, nframes, n1, tile_m),
+                         lambda c, j: (c, 0, 0, 0, j)),
+        ],
+        out_shape=out_shape,
+        interpret=interpret,
+    )(packed, wv, w1r, w1i, tr, ti)
+    return ur, ui
 
 
 def pfb_dequant(

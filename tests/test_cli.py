@@ -51,6 +51,15 @@ class TestReduce:
         assert json.loads(txt)["nchans"] == 2 * 8  # 0001: nfft=8
 
 
+def test_product_choices_mirror_presets():
+    # _PRODUCTS is hardcoded so light subcommands skip the jax import;
+    # this pin keeps it in lockstep with the real preset table.
+    from blit.__main__ import _PRODUCTS
+    from blit.pipeline import PRODUCT_PRESETS
+
+    assert tuple(sorted(PRODUCT_PRESETS)) == _PRODUCTS
+
+
 class TestInventoryInfo:
     def test_inventory_jsonl_and_sequences(self, tmp_path, capsys):
         root = str(tmp_path / "datax")

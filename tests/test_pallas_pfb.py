@@ -89,6 +89,33 @@ class TestPfbDequant:
         with pytest.raises(ValueError, match="pfb_kernel"):
             ch.channelize(v, h, nfft=64, pfb_kernel="cuda")
 
+    def test_fused1_matches_xla_end_to_end(self):
+        # dequant+PFB+stage1 fused: whole channelize parity on a
+        # multi-factor nfft (8192 -> factors (128, 64)).
+        rng = np.random.default_rng(5)
+        nfft, ntap = 8192, 4
+        v = rng.integers(-40, 40, (2, 6 * nfft, 2, 2), np.int8)
+        h = jnp.asarray(ch.pfb_coeffs(ntap, nfft))
+        a = np.asarray(ch.channelize(jnp.asarray(v), h, nfft=nfft,
+                                     stokes="IQUV", fft_method="matmul",
+                                     pfb_kernel="fused1"))
+        b = np.asarray(ch.channelize(jnp.asarray(v), h, nfft=nfft,
+                                     stokes="IQUV", fft_method="matmul",
+                                     pfb_kernel="xla"))
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-2 * np.abs(b).max())
+
+    def test_fused1_guards(self):
+        v = jnp.zeros((1, 6 * 256, 2, 2), jnp.int8)
+        h = jnp.asarray(ch.pfb_coeffs(4, 256))
+        with pytest.raises(ValueError, match="multi-factor"):
+            ch.channelize(v, h, nfft=256, fft_method="matmul",
+                          pfb_kernel="fused1")
+        v2 = jnp.zeros((1, 6 * 8192, 2, 2), jnp.int8)
+        h2 = jnp.asarray(ch.pfb_coeffs(4, 8192))
+        with pytest.raises(ValueError, match="twisted"):
+            ch.channelize(v2, h2, nfft=8192, fft_method="matmul",
+                          pfb_kernel="fused1", dft_order="twisted")
+
     def test_vmem_gate(self):
         from blit.ops import pallas_pfb as pp
 

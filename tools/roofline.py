@@ -206,6 +206,7 @@ def main() -> None:
 
     t, _ = timed(s_detect, sr, si)
     row("detect+transpose", t, 2 * plane, f32_plane // npol)
+    del sr, si  # free the pinned stage arrays before the whole-call rerun
 
     # -- whole fused call for comparison ------------------------------------
     from blit.ops.channelize import channelize
@@ -228,7 +229,7 @@ def main() -> None:
 
     print(f"\nroofline @ nchan={nchan} frames={frames} nfft=2^20 dtype={dtype}"
           f"  (plane={plane / 1e9:.2f} GB, HBM peak {HBM_PEAK_GBPS:.0f} GB/s)")
-    print(f"{'stage':<20}{'ms':>9}{'rd GB':>8}{'wr GB':>8}{'GB/s':>9}{'%roof':>7}")
+    print(f"{'stage':<22}{'ms':>9}{'rd GB':>8}{'wr GB':>8}{'GB/s':>9}{'%roof':>7}")
     tot_ms = tot_bytes = 0.0
     for name, s, rd, wr, gbps in rows:
         n_un = 2 if name.startswith("untwist") else 1
@@ -237,9 +238,9 @@ def main() -> None:
             tot_bytes += (rd + wr) * n_un
         print(f"{name:<22}{s * 1e3:>9.1f}{rd / 1e9:>8.2f}{wr / 1e9:>8.2f}"
               f"{gbps:>9.0f}{100 * gbps / HBM_PEAK_GBPS:>6.0f}%")
-    print(f"{'sum of stages':<20}{tot_ms:>9.1f}  (analytic min traffic "
+    print(f"{'sum of stages':<22}{tot_ms:>9.1f}  (analytic min traffic "
           f"{tot_bytes / 1e9:.1f} GB → {tot_bytes / HBM_PEAK_GBPS / 1e6:.1f} ms at roof)")
-    print(f"{'whole channelize':<20}{whole_t * 1e3:>9.1f}  net {net / 1e9:.3f} GB"
+    print(f"{'whole channelize':<22}{whole_t * 1e3:>9.1f}  net {net / 1e9:.3f} GB"
           f" → {net / whole_t / 1e9:.2f} GB/s/chip  (compile {compile_s:.0f}s)")
 
 
