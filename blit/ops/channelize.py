@@ -282,6 +282,7 @@ def integrate(power: jax.Array, nint: int) -> jax.Array:
     static_argnames=(
         "nfft", "ntap", "nint", "stokes", "fft_method", "precision",
         "channel_block", "dtype", "fqav_by", "dft_order", "pfb_kernel",
+        "detect_kernel",
     ),
 )
 def channelize(
@@ -299,6 +300,7 @@ def channelize(
     fqav_by: int = 1,
     dft_order: str = "auto",
     pfb_kernel: str = "auto",
+    detect_kernel: str = "auto",
 ) -> jax.Array:
     """The full single-chip reduction: int8 voltage block → filterbank slab.
 
@@ -420,6 +422,7 @@ def channelize(
             )
             if (
                 len(factors) >= 2
+                and not twisted  # fused1 ignores dft_order='twisted'
                 and pallas_pfb.fused1_fits(
                     nfft, nblk, ntap, factors[0], dtype
                 )
@@ -461,6 +464,27 @@ def channelize(
     use_fused1 = pfb_kernel == "fused1"
     interp = backend not in _MATMUL_ONLY_BACKENDS
 
+    # detect_kernel="pallas": fuse Stokes-I detection with the DFT untwist
+    # (blit/ops/pallas_detect.py) — the DFT tail runs in twisted order (no
+    # transposes) and one tile-wise pass detects + writes natural-order
+    # power.  Requires the fused1 front (twisted tail) and Stokes I.
+    # Interleaved A/B at the production config: 8.2-8.7 vs 8.1-8.2 GB/s —
+    # within rig noise, so "auto" stays on the XLA tail and the kernel
+    # remains an opt-in tuning surface (DESIGN.md §9).
+    if detect_kernel not in ("auto", "xla", "pallas"):
+        raise ValueError(f"bad detect_kernel {detect_kernel!r}")
+    detect_eligible = (
+        use_fused1
+        and stokes == "I"
+        and len(dftmod.default_factors(nfft)) <= 3
+    )
+    if detect_kernel == "pallas" and not detect_eligible:
+        raise ValueError(
+            "detect_kernel='pallas' needs pfb_kernel='fused1', stokes='I' "
+            "and <= 3 DFT factors"
+        )
+    use_pallas_detect = detect_kernel == "pallas" and detect_eligible
+
     def core(v):
         if use_fused1:
             # dequant + PFB + DFT stage 1 in one pallas pass; the frame
@@ -478,6 +502,18 @@ def channelize(
                 v, shifted_coeffs, w1r, w1i, t1r, t1i, dtype=dtype,
                 interpret=interp,
             )
+            if use_pallas_detect:
+                from blit.ops.pallas_detect import detect_untwist_i
+
+                # Remaining factors in twisted order (no transposes);
+                # the detect kernel untwists while it detects.
+                vr, vi = dftmod.dft_tail(
+                    ur, ui, factors, precision=prec, dtype=dtype,
+                    order="twisted",
+                )
+                power = detect_untwist_i(vr, vi, factors, interpret=interp)
+                # (cb, frames, nfft) → (cb, nif=1, t, nfft)
+                return integrate(power, nint)[:, None]
             sr, si = dftmod.dft_tail(
                 ur, ui, factors, precision=prec, dtype=dtype
             )

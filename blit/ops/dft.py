@@ -262,6 +262,7 @@ def dft_tail(
     *,
     precision=None,
     dtype: str = "float32",
+    order: str = "natural",
 ) -> Planar:
     """Finish a DFT whose first stage (n1-point matmul + twiddle) was
     computed externally — e.g. by the fused dequant+PFB+stage-1 pallas
@@ -269,13 +270,22 @@ def dft_tail(
     along the last axis and assemble natural frequency order.
 
     ``ur, ui``: ``(..., n1, m)`` stage-1 outputs (twiddle already applied).
-    Returns ``(..., n1*m)`` natural-order spectra.
+    Returns ``(..., n1*m)`` spectra — natural order, or the digit-permuted
+    layout of :func:`untwist` with ``order="twisted"`` (for order-oblivious
+    consumers like the fused detect kernel; keeps the twisted-flat layout
+    contract in this module).
     """
     n1, m = ur.shape[-2], ur.shape[-1]
     if factors[0] != n1 or int(np.prod(factors[1:])) != m:
         raise ValueError(f"dft_tail: factors {factors} mismatch ({n1}, {m})")
-    vr, vi = _dft_rec(ur, ui, factors[1:], precision, dtype)
+    if order not in ("natural", "twisted"):
+        raise ValueError(f"order must be 'natural' or 'twisted', got {order!r}")
     batch = ur.shape[:-2]
+    if order == "twisted":
+        vr, vi = _dft_rec(ur, ui, factors[1:], precision, dtype, twisted=True)
+        return (vr.reshape(batch + (n1 * m,)),
+                vi.reshape(batch + (n1 * m,)))
+    vr, vi = _dft_rec(ur, ui, factors[1:], precision, dtype)
     vr = jnp.swapaxes(vr, -1, -2).reshape(batch + (n1 * m,))
     vi = jnp.swapaxes(vi, -1, -2).reshape(batch + (n1 * m,))
     return vr, vi

@@ -1,0 +1,94 @@
+"""Pallas TPU kernel fusing Stokes-I detection with the DFT untwist.
+
+The matmul DFT's two per-level untwist transposes plus the detect pass
+move ~3 full planes of traffic after the last matmul stage (DESIGN.md §9:
+2×21 ms + 41 ms at the production shape).  Detection is elementwise, so it
+can read the spectra in TWISTED (digit-permuted) order — the layout
+`dft(order="twisted")` emits for free — and this kernel writes each
+detected tile straight into its natural-order position: the twisted axes
+``(k1, k2, klast)`` map to natural order by axis REVERSAL
+(blit/ops/dft.untwist), so an output block over reversed axes is still a
+rectangular BlockSpec slice, with the f1 axis (128 for the hi-res product)
+as the output lane dimension.  One pass replaces untwist+untwist+detect.
+
+The pure-XLA twisted experiment lost 20% because XLA lowered the reversed
+multi-axis power transpose badly (DESIGN.md §9 item 5); here the transpose
+happens tile-wise in VMEM with lane-aligned writes — measured on the chip
+before being wired as a default.
+
+Stokes I only; ≤ 3 DFT factors (axis reversal == middle-preserving only
+up to three digit axes); other products keep the unfused path.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+# Middle-axis tile: VMEM per instance ≈ npol·2·f1·tile_mid·flast·esize in
+# + flast·tile_mid·f1·4 out.  At the hi-res shape (f1=128, flast=64,
+# tile_mid=16, bf16): ~1 MB in + 0.5 MB out.
+_DEF_TILE_MID = 16
+
+
+def _detect_kernel(sr_ref, si_ref, o_ref):
+    # sr/si: (1, npol, 1, f1, tile_mid, flast); o: (1, 1, flast, tile_mid, f1)
+    sr = sr_ref[0, :, 0].astype(jnp.float32)
+    si = si_ref[0, :, 0].astype(jnp.float32)
+    p = (sr * sr + si * si).sum(axis=0)  # Stokes I over pols: (f1, mid, last)
+    o_ref[0, 0] = jnp.transpose(p, (2, 1, 0))
+
+
+def detect_untwist_i(
+    sr: jax.Array,
+    si: jax.Array,
+    factors: Tuple[int, ...],
+    *,
+    tile_mid: int = _DEF_TILE_MID,
+    interpret: bool = False,
+) -> jax.Array:
+    """Twisted planar spectra → natural-order Stokes-I power, one pass.
+
+    Args:
+      sr, si: ``(nchan, npol, nframes, n)`` spectra in the twisted layout
+        of ``dft(order="twisted")`` (n = prod(factors)).
+      factors: the DFT factorization that produced the twisted layout
+        (at most 3 factors — axis reversal handles one middle axis).
+
+    Returns float32 ``(nchan, nframes, n)`` natural-order total power.
+    """
+    from jax.experimental import pallas as pl
+
+    nchan, npol, nframes, n = sr.shape
+    if len(factors) > 3:
+        raise ValueError("detect_untwist_i supports at most 3 DFT factors")
+    if len(factors) == 1:
+        p = sr.astype(jnp.float32) ** 2 + si.astype(jnp.float32) ** 2
+        return p.sum(axis=1)
+    f1, flast = factors[0], factors[-1]
+    mid = n // (f1 * flast)
+    sr6 = sr.reshape(nchan, npol, nframes, f1, mid, flast)
+    si6 = si.reshape(nchan, npol, nframes, f1, mid, flast)
+    while mid % tile_mid:
+        tile_mid //= 2
+    tile_mid = max(tile_mid, 1)
+
+    in_spec = pl.BlockSpec((1, npol, 1, f1, tile_mid, flast),
+                           lambda c, f, j: (c, 0, f, 0, j, 0))
+    out_spec = pl.BlockSpec((1, 1, flast, tile_mid, f1),
+                            lambda c, f, j: (c, f, 0, j, 0))
+    out = pl.pallas_call(
+        _detect_kernel,
+        grid=(nchan, nframes, mid // tile_mid),
+        in_specs=[in_spec, in_spec],
+        out_specs=out_spec,
+        out_shape=jax.ShapeDtypeStruct(
+            (nchan, nframes, flast, mid, f1), jnp.float32
+        ),
+        interpret=interpret,
+    )(sr6, si6)
+    # (flast, mid, f1) row-major IS the natural order: natural index
+    # k = k1 + f1*(mid digits) + f1*mid*klast (axis reversal, dft.untwist).
+    return out.reshape(nchan, nframes, n)

@@ -1,0 +1,52 @@
+"""Fused detect+untwist kernel (blit/ops/pallas_detect.py), interpret mode."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from blit.ops import channelize as ch  # noqa: E402
+from blit.ops import dft as D  # noqa: E402
+from blit.ops.pallas_detect import detect_untwist_i  # noqa: E402
+
+
+class TestDetectUntwist:
+    @pytest.mark.parametrize("factors", [(8, 4), (8, 4, 4), (16,)])
+    def test_matches_untwist_then_detect(self, factors):
+        rng = np.random.default_rng(0)
+        n = int(np.prod(factors))
+        nchan, npol, nframes = 2, 2, 3
+        sr = rng.standard_normal((nchan, npol, nframes, n)).astype(np.float32)
+        si = rng.standard_normal((nchan, npol, nframes, n)).astype(np.float32)
+        got = np.asarray(detect_untwist_i(
+            jnp.asarray(sr), jnp.asarray(si), factors, interpret=True))
+        nat_r = np.asarray(D.untwist(jnp.asarray(sr), factors))
+        nat_i = np.asarray(D.untwist(jnp.asarray(si), factors))
+        want = (nat_r**2 + nat_i**2).sum(axis=1)
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-5)
+
+    def test_channelize_fused_detect_matches(self):
+        rng = np.random.default_rng(4)
+        nfft, ntap = 8192, 4
+        v = rng.integers(-40, 40, (2, 7 * nfft, 2, 2), np.int8)
+        h = jnp.asarray(ch.pfb_coeffs(ntap, nfft))
+        a = np.asarray(ch.channelize(
+            jnp.asarray(v), h, nfft=nfft, nint=2, fft_method="matmul",
+            pfb_kernel="fused1", detect_kernel="pallas"))
+        b = np.asarray(ch.channelize(
+            jnp.asarray(v), h, nfft=nfft, nint=2, fft_method="matmul",
+            pfb_kernel="xla"))
+        np.testing.assert_allclose(a, b, rtol=1e-4,
+                                   atol=1e-2 * np.abs(b).max())
+
+    def test_guards(self):
+        v = jnp.zeros((1, 7 * 8192, 2, 2), jnp.int8)
+        h = jnp.asarray(ch.pfb_coeffs(4, 8192))
+        with pytest.raises(ValueError, match="detect_kernel"):
+            ch.channelize(v, h, nfft=8192, fft_method="matmul",
+                          pfb_kernel="xla", detect_kernel="pallas")
+        with pytest.raises(ValueError, match="detect_kernel"):
+            ch.channelize(v, h, nfft=8192, fft_method="matmul",
+                          pfb_kernel="fused1", stokes="IQUV",
+                          detect_kernel="pallas")
