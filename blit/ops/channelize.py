@@ -473,15 +473,20 @@ def channelize(
     # remains an opt-in tuning surface (DESIGN.md §9).
     if detect_kernel not in ("auto", "xla", "pallas"):
         raise ValueError(f"bad detect_kernel {detect_kernel!r}")
-    detect_eligible = (
-        use_fused1
-        and stokes == "I"
-        and len(dftmod.default_factors(nfft)) <= 3
-    )
+    if use_fused1 and stokes == "I":
+        from blit.ops import pallas_detect
+
+        detect_eligible = pallas_detect.fits(
+            dftmod.default_factors(nfft),
+            npol=voltages.shape[2],
+            esize=2 if dtype == "bfloat16" else 4,
+        )
+    else:
+        detect_eligible = False
     if detect_kernel == "pallas" and not detect_eligible:
         raise ValueError(
-            "detect_kernel='pallas' needs pfb_kernel='fused1', stokes='I' "
-            "and <= 3 DFT factors"
+            "detect_kernel='pallas' needs pfb_kernel='fused1', stokes='I', "
+            "<= 3 DFT factors, and factor sizes inside the VMEM budget"
         )
     use_pallas_detect = detect_kernel == "pallas" and detect_eligible
 

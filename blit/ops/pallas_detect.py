@@ -32,6 +32,36 @@ import jax.numpy as jnp
 # tile_mid=16, bf16): ~1 MB in + 0.5 MB out.
 _DEF_TILE_MID = 16
 
+# Per-instance VMEM budget (matches pallas_pfb's stance: leave headroom
+# for double buffering on a ~16 MB part).
+_VMEM_BUDGET = 6 << 20
+
+
+def _fit_tile(factors, npol: int, esize: int, tile_mid: int) -> int:
+    """Largest mid-axis tile (a divisor of mid, <= tile_mid) whose blocks
+    fit the VMEM budget; 0 if none does even at tile_mid=1 — f1/flast are
+    never tiled, so huge factor sizes must take the XLA path."""
+    f1, flast = factors[0], factors[-1]
+    n = 1
+    for f in factors:
+        n *= f
+    mid = n // (f1 * flast)
+    while mid % tile_mid:
+        tile_mid //= 2
+    while tile_mid >= 1:
+        per = f1 * tile_mid * flast
+        if per * (npol * 2 * esize) + per * 4 <= _VMEM_BUDGET:
+            return tile_mid
+        tile_mid //= 2
+    return 0
+
+
+def fits(factors, npol: int = 2, esize: int = 2,
+         tile_mid: int = _DEF_TILE_MID) -> bool:
+    """VMEM-fit gate for :func:`detect_untwist_i` — the check
+    ``channelize`` runs before allowing ``detect_kernel="pallas"``."""
+    return len(factors) <= 3 and _fit_tile(factors, npol, esize, tile_mid) > 0
+
 
 def _detect_kernel(sr_ref, si_ref, o_ref):
     # sr/si: (1, npol, 1, f1, tile_mid, flast); o: (1, 1, flast, tile_mid, f1)
@@ -71,9 +101,12 @@ def detect_untwist_i(
     mid = n // (f1 * flast)
     sr6 = sr.reshape(nchan, npol, nframes, f1, mid, flast)
     si6 = si.reshape(nchan, npol, nframes, f1, mid, flast)
-    while mid % tile_mid:
-        tile_mid //= 2
-    tile_mid = max(tile_mid, 1)
+    tile_mid = _fit_tile(factors, npol, sr.dtype.itemsize, tile_mid)
+    if tile_mid == 0:
+        raise ValueError(
+            f"detect_untwist_i: factor sizes {factors} exceed the VMEM "
+            "budget (f1/flast are untiled) — use the XLA detect path"
+        )
 
     in_spec = pl.BlockSpec((1, npol, 1, f1, tile_mid, flast),
                            lambda c, f, j: (c, 0, f, 0, j, 0))

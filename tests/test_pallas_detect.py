@@ -12,15 +12,22 @@ from blit.ops.pallas_detect import detect_untwist_i  # noqa: E402
 
 
 class TestDetectUntwist:
-    @pytest.mark.parametrize("factors", [(8, 4), (8, 4, 4), (16,)])
-    def test_matches_untwist_then_detect(self, factors):
+    # (8, 32, 4) with tile_mid=16 spans mid=32 over TWO grid tiles — the
+    # j index-map path the production 2^20 shape (mid=128, 8 tiles) uses;
+    # tile_mid=2 forces 16 tiles over the same shape.
+    @pytest.mark.parametrize("factors,tile_mid", [
+        ((8, 4), 16), ((8, 4, 4), 16), ((16,), 16),
+        ((8, 32, 4), 16), ((8, 32, 4), 2),
+    ])
+    def test_matches_untwist_then_detect(self, factors, tile_mid):
         rng = np.random.default_rng(0)
         n = int(np.prod(factors))
         nchan, npol, nframes = 2, 2, 3
         sr = rng.standard_normal((nchan, npol, nframes, n)).astype(np.float32)
         si = rng.standard_normal((nchan, npol, nframes, n)).astype(np.float32)
         got = np.asarray(detect_untwist_i(
-            jnp.asarray(sr), jnp.asarray(si), factors, interpret=True))
+            jnp.asarray(sr), jnp.asarray(si), factors, tile_mid=tile_mid,
+            interpret=True))
         nat_r = np.asarray(D.untwist(jnp.asarray(sr), factors))
         nat_i = np.asarray(D.untwist(jnp.asarray(si), factors))
         want = (nat_r**2 + nat_i**2).sum(axis=1)
@@ -39,6 +46,17 @@ class TestDetectUntwist:
             pfb_kernel="xla"))
         np.testing.assert_allclose(a, b, rtol=1e-4,
                                    atol=1e-2 * np.abs(b).max())
+
+    def test_vmem_gate(self):
+        from blit.ops import pallas_detect as pd
+
+        assert pd.fits((128, 128, 64))  # the hi-res production shape
+        assert pd.fits((128, 128, 1024))  # 2^24: fits by shrinking tile_mid
+        # f1 and flast are untiled, so a square 1M split cannot fit.
+        assert not pd.fits((1024, 1024))
+        sr = jnp.zeros((1, 2, 1, 1024 * 1024), jnp.bfloat16)
+        with pytest.raises(ValueError, match="VMEM"):
+            detect_untwist_i(sr, sr, (1024, 1024), interpret=True)
 
     def test_guards(self):
         v = jnp.zeros((1, 7 * 8192, 2, 2), jnp.int8)

@@ -111,6 +111,24 @@ def run():
     np.testing.assert_allclose(np.asarray(visi), want.imag, rtol=2e-2, atol=2e-1)
     print("correlator: ok")
 
+    # Pallas kernels compile and agree NATIVELY on the chip (the CPU suite
+    # only exercises them in interpreter mode): fused dequant+PFB+stage-1
+    # and the fused detect+untwist, tiny multi-factor shapes.
+    from blit.ops.channelize import channelize, channelize_np
+
+    pfft = 8192  # > DIRECT_DFT_MAX -> multi-level matmul path
+    pv = rng.integers(-40, 40, (1, 6 * pfft, 2, 2)).astype(np.int8)
+    ph = pfb_coeffs(4, pfft)
+    want = channelize_np(pv, ph, nfft=pfft)
+    scale = np.abs(want).max()
+    for kern, dk in (("fused1", "xla"), ("fused1", "pallas"), ("pallas", "xla")):
+        got = np.asarray(channelize(
+            jnp.asarray(pv), jnp.asarray(ph), nfft=pfft,
+            fft_method="matmul", pfb_kernel=kern, detect_kernel=dk,
+        ))
+        assert np.abs(got - want).max() / scale < 2e-2, (kern, dk)
+    print("pallas kernels: ok")
+
 try:
     run()
 except BaseException as e:
@@ -159,3 +177,4 @@ def test_collectives_per_chip_math_runs_on_hardware():
         pytest.skip("hardware smoke infrastructure failure:\n" + blob[-1500:])
     assert "beamform: ok" in proc.stdout
     assert "correlator: ok" in proc.stdout
+    assert "pallas kernels: ok" in proc.stdout
