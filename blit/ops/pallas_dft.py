@@ -156,6 +156,154 @@ def stage_reference(xr, xi, wr, wi, tr=None, ti=None):
     return sr * tr - si * ti, sr * ti + si * tr
 
 
+def _tail2_kernel(out_dtype,
+                  xr_ref, xi_ref, w2r_ref, w2i_ref, w3r_ref, w3i_ref,
+                  tr_ref, ti_ref, or_ref, oi_ref):
+    """Two DFT levels + the inner untwist in one VMEM pass.
+
+    Blocks: x (tile_b, f2, f3) planar pair — one stage-1 output row panel
+    per batch element; out (tile_b, f3, f2) natural-m order.
+    """
+    # No in-kernel reshapes: mosaic rejects collapses of transposed vector
+    # axes — everything rides batched dot_generals and transposes.
+    xr = xr_ref[...].astype(jnp.float32)  # (tile_b, f2, f3)
+    xi = xi_ref[...].astype(jnp.float32)
+    w2r = w2r_ref[...]
+    w2i = w2i_ref[...]
+
+    def stage2(w, a):
+        # (b, f2l, f3) × (f2k, f2l) → dot layout (b, f3, f2k)
+        return jax.lax.dot_general(
+            a, w, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    rr = stage2(w2r, xr)
+    ii = stage2(w2i, xi)
+    ri = stage2(w2r, xi)
+    ir = stage2(w2i, xr)
+    # Combine in the dot layout, transpose only the two results.
+    sr = (rr - ii).transpose(0, 2, 1)  # (b, f2k, f3)
+    si = (ri + ir).transpose(0, 2, 1)
+    # Level-2 twiddle exp(-2πi k2 j3 / (f2 f3)): (f2, f3), broadcast over b.
+    tr = tr_ref[...][None]
+    ti = ti_ref[...][None]
+    ur = sr * tr - si * ti
+    ui = sr * ti + si * tr
+    # Stage 3 contracts the f3 (last) axis against the symmetric W3.
+    w3r = w3r_ref[...]
+    w3i = w3i_ref[...]
+
+    def stage3(a, w):
+        # (b, f2, f3j) × (f3j, f3k) → (b, f2, f3k)
+        return jax.lax.dot_general(
+            a, w, (((2,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    ar = stage3(ur, w3r)
+    bi = stage3(ui, w3i)
+    br = stage3(ui, w3r)
+    ai = stage3(ur, w3i)
+    vr = ar - bi
+    vi = br + ai
+    # Inner untwist: natural m-index = k2 + f2*k3 → layout (k3, k2).
+    or_ref[...] = jnp.transpose(vr, (0, 2, 1)).astype(out_dtype)
+    oi_ref[...] = jnp.transpose(vi, (0, 2, 1)).astype(out_dtype)
+
+
+# Per-instance VMEM budget for dft_tail2 (conservative: in+out blocks plus
+# ~6 f32 scratch panels per tile element, plus the constant matrices).
+_TAIL2_VMEM_BUDGET = 6 << 20
+
+
+def _tail2_tile(b: int, f2: int, f3: int, esize: int, tile_b: int) -> int:
+    """Largest tile_b (divisor of b, <= tile_b) fitting the VMEM budget;
+    0 when even tile_b=1 is too large (huge f2·f3 panels)."""
+    consts = (f2 * f2 + f3 * f3 + f2 * f3) * 8
+    while tile_b >= 1:
+        if b % tile_b == 0:
+            per = tile_b * f2 * f3
+            if consts + per * (4 * esize + 6 * 4) <= _TAIL2_VMEM_BUDGET:
+                return tile_b
+        tile_b //= 2
+    return 0
+
+
+def tail2_fits(b: int, f2: int, f3: int, dtype: str = "float32",
+               tile_b: int = 16) -> bool:
+    """VMEM-fit gate for :func:`dft_tail2` — checked by ``channelize``
+    before 'auto' prefers the fused tail."""
+    esize = 2 if dtype == "bfloat16" else 4
+    return _tail2_tile(b, f2, f3, esize, tile_b) > 0
+
+
+def dft_tail2(
+    xr: jax.Array,
+    xi: jax.Array,
+    f2: int,
+    f3: int,
+    *,
+    dtype: str = "float32",
+    tile_b: int = 16,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """Fused final two Cooley-Tukey levels + inner untwist.
+
+    For a 3-factor DFT (f1, f2, f3), consumes the stage-1 outputs
+    ``(..., m)`` with ``m = f2·f3`` (the per-``k1`` row panels of
+    blit/ops/pallas_pfb.pfb_dft1, batch = everything else) and returns the
+    natural-order sub-spectra ``(..., m)`` — replacing two einsum stages,
+    a twiddle pass, and one materialized transpose with a single pallas
+    pass (three large MXU matmuls per tile).  The caller's remaining work
+    is the level-0 untwist only.
+    """
+    from jax.experimental import pallas as pl
+
+    from blit.ops.dft import dft_matrices, twiddles
+
+    m = xr.shape[-1]
+    if m != f2 * f3:
+        raise ValueError(f"dft_tail2: last axis {m} != {f2}*{f3}")
+    batch = xr.shape[:-1]
+    b = 1
+    for d in batch:
+        b *= d
+    esize = 2 if dtype == "bfloat16" else 4
+    tile_b = _tail2_tile(b, f2, f3, esize, tile_b)
+    if tile_b == 0:
+        raise ValueError(
+            f"dft_tail2: ({f2}, {f3}) panels exceed the VMEM budget — use "
+            "the XLA tail (channelize tail_kernel='xla'; 'auto' gates on "
+            "tail2_fits)"
+        )
+    xr3 = xr.reshape(b, f2, f3)
+    xi3 = xi.reshape(b, f2, f3)
+    w2r, w2i = (jnp.asarray(a) for a in dft_matrices(f2, "float32"))
+    w3r, w3i = (jnp.asarray(a) for a in dft_matrices(f3, "float32"))
+    t2r, t2i = (jnp.asarray(a) for a in twiddles(f2, f3, "float32"))
+    out_dtype = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+    kern = functools.partial(_tail2_kernel, out_dtype)
+    x_spec = pl.BlockSpec((tile_b, f2, f3), lambda i: (i, 0, 0))
+    o_spec = pl.BlockSpec((tile_b, f3, f2), lambda i: (i, 0, 0))
+    w_spec2 = pl.BlockSpec((f2, f2), lambda i: (0, 0))
+    w_spec3 = pl.BlockSpec((f3, f3), lambda i: (0, 0))
+    t_spec = pl.BlockSpec((f2, f3), lambda i: (0, 0))
+    vr, vi = pl.pallas_call(
+        kern,
+        grid=(b // tile_b,),
+        in_specs=[x_spec, x_spec, w_spec2, w_spec2, w_spec3, w_spec3,
+                  t_spec, t_spec],
+        out_specs=[o_spec, o_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, f3, f2), out_dtype),
+            jax.ShapeDtypeStruct((b, f3, f2), out_dtype),
+        ],
+        interpret=interpret,
+    )(xr3, xi3, w2r, w2i, w3r, w3i, t2r, t2i)
+    return vr.reshape(batch + (m,)), vi.reshape(batch + (m,))
+
+
 def _last_kernel(xr_ref, xi_ref, wr_ref, wi_ref, or_ref, oi_ref):
     wr = wr_ref[...]
     wi = wi_ref[...]

@@ -82,3 +82,44 @@ class TestDftIntegration:
         wr, wi = D.dft_np(np.asarray(xr), np.asarray(xi))
         scale = np.abs(wr + 1j * wi).max()
         assert np.abs(np.asarray(yr) - wr).max() / scale < 1e-3
+
+
+class TestDftTail2:
+    @pytest.mark.parametrize("f2,f3,tile_b", [(8, 4, 4), (16, 8, 2), (8, 8, 3)])
+    def test_matches_two_factor_dft(self, f2, f3, tile_b):
+        # dft_tail2 == a natural-order (f2, f3)-factored DFT of each row
+        # (the tail of a 3-factor transform after its stage 1).
+        m = f2 * f3
+        xr, xi = planar((2, 3, m), seed=6)
+        got_r, got_i = P.dft_tail2(jnp.asarray(xr), jnp.asarray(xi), f2, f3,
+                                   tile_b=tile_b, interpret=True)
+        want_r, want_i = D.dft(jnp.asarray(xr), jnp.asarray(xi),
+                               factors=(f2, f3),
+                               precision=jax.lax.Precision.HIGHEST)
+        np.testing.assert_allclose(np.asarray(got_r), np.asarray(want_r),
+                                   rtol=1e-4, atol=1e-3)
+        np.testing.assert_allclose(np.asarray(got_i), np.asarray(want_i),
+                                   rtol=1e-4, atol=1e-3)
+
+    def test_channelize_guard(self):
+        # tail_kernel='pallas' needs fused1 + exactly 3 factors.
+        from blit.ops.channelize import channelize, pfb_coeffs
+
+        v = jnp.zeros((1, 7 * 8192, 2, 2), jnp.int8)
+        h = jnp.asarray(pfb_coeffs(4, 8192))
+        with pytest.raises(ValueError, match="tail_kernel"):
+            channelize(v, h, nfft=8192, fft_method="matmul",
+                       pfb_kernel="fused1", tail_kernel="pallas")
+
+    def test_vmem_gate_and_conflict(self):
+        from blit.ops.channelize import channelize, pfb_coeffs
+        from blit.ops.pallas_dft import tail2_fits
+
+        assert tail2_fits(48 * 2 * 8 * 128, 128, 64, "bfloat16")  # prod
+        assert not tail2_fits(1, 2048, 4096)  # huge panels, even tile_b=1
+        v = jnp.zeros((1, 7 * 8192, 2, 2), jnp.int8)
+        h = jnp.asarray(pfb_coeffs(4, 8192))
+        with pytest.raises(ValueError, match="replaces the tail"):
+            channelize(v, h, nfft=8192, fft_method="matmul",
+                       pfb_kernel="fused1", detect_kernel="pallas",
+                       tail_kernel="pallas")
