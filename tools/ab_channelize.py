@@ -1,0 +1,97 @@
+"""Interleaved on-chip A/B of two `channelize` kwarg variants.
+
+The rig's run-to-run variance is ±25% (DESIGN.md §9 item 6), so kernel
+comparisons are honest only when the variants interleave in ONE process:
+A-block, B-block, A-block, ... with each block timed by the §9
+methodology — per-call device-side scalar sink, K calls enqueued
+back-to-back, exactly one scalar fetch closing the window (the in-order
+queue guarantees all enqueued calls executed; per-rep fetches would time
+the tunnel's ~100 ms RPC latency instead of the chip).
+
+Usage:
+    python tools/ab_channelize.py '{"tail_kernel": "pallas"}' \
+        '{"tail_kernel": "pallas", "detect_kernel": "pallas"}' \
+        [nchan frames dtype rounds K]
+
+Prints per-round GB/s for each variant and the pooled summary.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv) -> int:
+    kw_a = json.loads(argv[1])
+    kw_b = json.loads(argv[2])
+    nchan = int(argv[3]) if len(argv) > 3 else 48
+    frames = int(argv[4]) if len(argv) > 4 else 8
+    dtype = argv[5] if len(argv) > 5 else "bfloat16"
+    rounds = int(argv[6]) if len(argv) > 6 else 3
+    reps = int(argv[7]) if len(argv) > 7 else 4
+
+    cache = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), ".jax_cache")
+    jax.config.update("jax_compilation_cache_dir", cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    from blit.ops.channelize import channelize, pfb_coeffs
+
+    nfft, ntap = 1 << 20, 4
+    ntime = (ntap - 1 + frames) * nfft
+    rng = np.random.default_rng(0)
+    v = jnp.asarray(rng.integers(
+        -40, 40, size=(nchan, ntime, 2, 2), dtype=np.int8))
+    coeffs = jnp.asarray(pfb_coeffs(ntap, nfft))
+    base = dict(nfft=nfft, ntap=ntap, nint=1, stokes="I",
+                fft_method="auto", dtype=dtype)
+    net_bytes = frames * nfft * nchan * 4  # int8 (2 pol × re/im) per call
+
+    def make(kw):
+        merged = {**base, **kw}
+
+        @jax.jit
+        def f(x):
+            return jnp.sum(channelize(x, coeffs, **merged))
+
+        return f
+
+    fa, fb = make(kw_a), make(kw_b)
+    # Warm both (compile + first-run allocs), then one fetch each.
+    t0 = time.time()
+    float(fa(v))
+    float(fb(v))
+    print(f"warmup (incl. compile) {time.time() - t0:.1f}s", flush=True)
+
+    def block(f):
+        t0 = time.time()
+        out = None
+        for _ in range(reps):
+            out = f(v)
+        float(out)  # one fetch; in-order queue ⇒ all reps executed
+        dt = time.time() - t0
+        return reps * net_bytes / dt / 1e9
+
+    ga, gb = [], []
+    for r in range(rounds):
+        ga.append(block(fa))
+        gb.append(block(fb))
+        print(f"round {r}: A {ga[-1]:.2f}  B {gb[-1]:.2f} GB/s", flush=True)
+    print(f"A {kw_a}: {min(ga):.2f}-{max(ga):.2f} GB/s")
+    print(f"B {kw_b}: {min(gb):.2f}-{max(gb):.2f} GB/s")
+    print(f"median ratio B/A: {np.median(gb) / np.median(ga):.3f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
