@@ -298,5 +298,8 @@ def tail2_detect_i(
         interpret=interpret,
     )(ur6, ui6, w2r, w2i, w3r, w3i, t2r, t2i)
     # One XLA lane swap finishes natural order — (f3, f2, f1) row-major is
-    # the per-channel natural index k = k1 + f1·k2 + f1·f2·k3.
+    # the per-channel natural index k = k1 + f1·k2 + f1·f2·k3.  (A pallas
+    # per-tile transpose of the same swap was measured SLOWER: 20.2 vs
+    # 11.9 ms at the production shape — mosaic's lane⇄sublane relayout
+    # loses to XLA's transpose lowering here, so the swap stays in XLA.)
     return jnp.swapaxes(out, -1, -2).reshape(nframes, nchan, f1 * m)

@@ -8,12 +8,18 @@ back-to-back, exactly one scalar fetch closing the window (the in-order
 queue guarantees all enqueued calls executed; per-rep fetches would time
 the tunnel's ~100 ms RPC latency instead of the chip).
 
-Usage:
-    python tools/ab_channelize.py '{"tail_kernel": "pallas"}' \
+Usage (note: "auto" resolves to the fused tail+detect whenever eligible,
+so pin the baseline's kernels explicitly — e.g. the tail-only kernel is
+detect_kernel="xla"):
+    python tools/ab_channelize.py \
+        '{"tail_kernel": "pallas", "detect_kernel": "xla"}' \
         '{"tail_kernel": "pallas", "detect_kernel": "pallas"}' \
         [nchan frames dtype rounds K]
 
-Prints per-round GB/s for each variant and the pooled summary.
+A variant may also override the dispatch shape itself with the pseudo
+kwargs "nchan"/"frames" (popped before the channelize call), e.g.
+'{"nchan": 64}' A/Bs 64 coarse channels against the base shape at equal
+net-bytes accounting.  Prints per-round GB/s and the pooled summary.
 """
 
 from __future__ import annotations
@@ -48,32 +54,38 @@ def main(argv) -> int:
     from blit.ops.channelize import channelize, pfb_coeffs
 
     nfft, ntap = 1 << 20, 4
-    ntime = (ntap - 1 + frames) * nfft
-    rng = np.random.default_rng(0)
-    v = jnp.asarray(rng.integers(
-        -40, 40, size=(nchan, ntime, 2, 2), dtype=np.int8))
     coeffs = jnp.asarray(pfb_coeffs(ntap, nfft))
     base = dict(nfft=nfft, ntap=ntap, nint=1, stokes="I",
                 fft_method="auto", dtype=dtype)
-    net_bytes = frames * nfft * nchan * 4  # int8 (2 pol × re/im) per call
+
+    inputs = {}  # (nchan, frames) -> shared device array: equal shapes
+    # time the SAME tensor, and distinct shapes don't double input HBM.
 
     def make(kw):
+        kw = dict(kw)
+        nc = int(kw.pop("nchan", nchan))
+        fr = int(kw.pop("frames", frames))
+        if (nc, fr) not in inputs:
+            ntime = (ntap - 1 + fr) * nfft
+            inputs[(nc, fr)] = jnp.asarray(np.random.default_rng(0).integers(
+                -40, 40, size=(nc, ntime, 2, 2), dtype=np.int8))
         merged = {**base, **kw}
 
         @jax.jit
         def f(x):
             return jnp.sum(channelize(x, coeffs, **merged))
 
-        return f
+        return f, inputs[(nc, fr)], fr * nfft * nc * 4  # int8 2pol×re/im
 
-    fa, fb = make(kw_a), make(kw_b)
+    fa, va, na = make(kw_a)
+    fb, vb, nb = make(kw_b)
     # Warm both (compile + first-run allocs), then one fetch each.
     t0 = time.time()
-    float(fa(v))
-    float(fb(v))
+    float(fa(va))
+    float(fb(vb))
     print(f"warmup (incl. compile) {time.time() - t0:.1f}s", flush=True)
 
-    def block(f):
+    def block(f, v, net_bytes):
         t0 = time.time()
         out = None
         for _ in range(reps):
@@ -84,8 +96,8 @@ def main(argv) -> int:
 
     ga, gb = [], []
     for r in range(rounds):
-        ga.append(block(fa))
-        gb.append(block(fb))
+        ga.append(block(fa, va, na))
+        gb.append(block(fb, vb, nb))
         print(f"round {r}: A {ga[-1]:.2f}  B {gb[-1]:.2f} GB/s", flush=True)
     print(f"A {kw_a}: {min(ga):.2f}-{max(ga):.2f} GB/s")
     print(f"B {kw_b}: {min(gb):.2f}-{max(gb):.2f} GB/s")
