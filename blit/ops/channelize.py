@@ -469,15 +469,17 @@ def channelize(
     # pipeline after the fused1 front (each measured on the chip,
     # DESIGN.md §9):
     #
-    # - COMBINED tail+detect (blit/ops/pallas_detect.tail2_detect_i,
-    #   ``use_td``): DFT levels 2+3, the inner untwist, Stokes-I detection
-    #   across both pols, and (up to one XLA lane swap) the product
+    # - COMBINED tail+detect (blit/ops/pallas_detect.tail2_detect,
+    #   ``use_td``): DFT levels 2+3, the inner untwist, the detection
+    #   product (any detect_stokes_planar product — the pol pair is
+    #   block-resident), and (up to one XLA lane swap) the product
     #   transpose in ONE pass — the bf16 tail spectra never exist in HBM.
     #   Interleaved A/B at the production config: 15.1-16.7 vs
     #   9.9-11.0 GB/s (+48%) — "auto" prefers it whenever eligible.
     # - tail-only (blit/ops/pallas_dft.dft_tail2, ``use_pallas_tail``):
     #   levels 2+3 + inner untwist, XLA detect.  A/B: +15% over the XLA
-    #   tail — the fallback when detection cannot fuse (stokes != "I").
+    #   tail — the fallback when the combined kernel's output planes
+    #   exceed VMEM.
     # - detect-only (blit/ops/pallas_detect.detect_untwist_i,
     #   ``use_pallas_detect``): twisted XLA tail, fused detect+untwist.
     #   A/B: parity — a verified-correct opt-in tuning surface.
@@ -485,23 +487,22 @@ def channelize(
         raise ValueError(f"bad detect_kernel {detect_kernel!r}")
     if tail_kernel not in ("auto", "xla", "pallas"):
         raise ValueError(f"bad tail_kernel {tail_kernel!r}")
-    if use_fused1 and stokes == "I":
+    detect_eligible = td_eligible = tail_eligible = False
+    if use_fused1:
         from blit.ops import pallas_detect
+        from blit.ops.pallas_dft import tail2_fits
 
         _kw = dict(
             npol=voltages.shape[2],
             esize=2 if dtype == "bfloat16" else 4,
         )
         _factors = dftmod.default_factors(nfft)
-        detect_eligible = pallas_detect.fits(_factors, **_kw)
-        td_eligible = pallas_detect.tail2_detect_fits(_factors, **_kw)
-    else:
-        detect_eligible = False
-        td_eligible = False
-    if use_fused1:
-        from blit.ops.pallas_dft import tail2_fits
-
-        _factors = dftmod.default_factors(nfft)
+        # detect_untwist_i is Stokes-I only; tail2_detect covers every
+        # detect_stokes_planar product (the pol pair is block-resident).
+        detect_eligible = stokes == "I" and pallas_detect.fits(
+            _factors, **_kw)
+        td_eligible = pallas_detect.tail2_detect_fits(
+            _factors, stokes=stokes, **_kw)
         _nframes = voltages.shape[1] // nfft - ntap + 1
         tail_eligible = (
             len(_factors) == 3
@@ -511,8 +512,6 @@ def channelize(
                 _factors[1], _factors[2], dtype,
             )
         )
-    else:
-        tail_eligible = False
 
     use_td = (
         td_eligible and detect_kernel != "xla" and tail_kernel != "xla"
@@ -520,16 +519,18 @@ def channelize(
     if detect_kernel == "pallas" and tail_kernel == "pallas" and not use_td:
         raise ValueError(
             "tail_kernel='pallas' with detect_kernel='pallas' (the fused "
-            "tail+detect) needs pfb_kernel='fused1', stokes='I', exactly "
-            "3 DFT factors, and panels inside the VMEM budget"
+            "tail+detect) needs pfb_kernel='fused1', a known stokes "
+            "product, exactly 3 DFT factors, and the nif output planes "
+            "inside the VMEM budget"
         )
     use_pallas_detect = (
         not use_td and detect_kernel == "pallas" and detect_eligible
     )
     if detect_kernel == "pallas" and not (use_td or use_pallas_detect):
         raise ValueError(
-            "detect_kernel='pallas' needs pfb_kernel='fused1', stokes='I', "
-            "<= 3 DFT factors, and factor sizes inside the VMEM budget"
+            "detect_kernel='pallas' (without tail_kernel='pallas') needs "
+            "pfb_kernel='fused1', stokes='I', <= 3 DFT factors, and "
+            "factor sizes inside the VMEM budget"
         )
     use_pallas_tail = (
         not use_td and not use_pallas_detect
@@ -559,14 +560,15 @@ def channelize(
                 interpret=interp,
             )
             if use_td:
-                from blit.ops.pallas_detect import tail2_detect_i
+                from blit.ops.pallas_detect import tail2_detect
 
                 # Whole remaining pipeline — tail levels, untwist, detect,
                 # product transpose — in one pass; power arrives frame-
                 # major in the product layout.
-                power = tail2_detect_i(
-                    ur, ui, factors[1], factors[2], interpret=interp,
-                )  # (nframes, cb, nfft)
+                power = tail2_detect(
+                    ur, ui, factors[1], factors[2], stokes=stokes,
+                    interpret=interp,
+                )  # (nframes, nif, cb, nfft)
                 if nint > 1:
                     if power.shape[0] % nint:
                         raise ValueError(
@@ -576,7 +578,7 @@ def channelize(
                     power = power.reshape(
                         (power.shape[0] // nint, nint) + power.shape[1:]
                     ).sum(axis=1)
-                return power  # (ntime_out, cb, nfft)
+                return power  # (ntime_out, nif, cb, nfft)
             if use_pallas_detect:
                 from blit.ops.pallas_detect import detect_untwist_i
 
@@ -645,18 +647,18 @@ def channelize(
         )
         power = jax.lax.map(core, groups)
         if use_td:
-            # (g, t, cb, nfft): channel-major assembly — one transpose of
-            # the (already detected, single-plane) power, the blocked
+            # (g, t, nif, cb, nfft): channel-major assembly — one
+            # transpose of the (already detected) power, the blocked
             # mode's price.
-            power = jnp.moveaxis(power, 0, 1)  # (t, g, cb, nfft)
+            power = jnp.moveaxis(power, 0, 2)  # (t, nif, g, cb, nfft)
         else:
             power = power.reshape((nchan,) + power.shape[2:])
     else:
         power = core(voltages)
     if use_td:
         # core's fused tail+detect already emitted the product layout
-        # (t, [g,] cb, nfft); flatten the channel axes into place.
-        out = power.reshape(power.shape[0], 1, nchan * nfft)
+        # (t, nif, [g,] cb, nfft); flatten the channel axes into place.
+        out = power.reshape(power.shape[0], power.shape[1], nchan * nfft)
     else:
         # → (ntime_out, nif, nchan*nfft), channel fastest.
         out = jnp.transpose(power, (2, 1, 0, 3))

@@ -23,8 +23,11 @@ transposes plus a separate detect pass:
   The bf16 tail spectra never exist in HBM and the product needs no
   further transpose.
 
-Stokes I only; ≤ 3 DFT factors (axis reversal == middle-preserving only
-up to three digit axes); other products keep the unfused path.
+:func:`detect_untwist_i` is Stokes I only; :func:`tail2_detect` covers
+every ``detect_stokes_planar`` product (the polarization pair is
+block-resident, so cross products cost only extra output planes).  Both
+need ≤ 3 DFT factors (axis reversal == middle-preserving only up to
+three digit axes); ineligible shapes keep the unfused path.
 """
 
 from __future__ import annotations
@@ -135,18 +138,28 @@ def detect_untwist_i(
     return out.reshape(nchan, nframes, n)
 
 
+# nif (product-plane count) per detection product — mirrors
+# blit.ops.channelize.detect_stokes_planar's table.
+_STOKES_NIF = {"I": 1, "XX": 1, "YY": 1, "XXYY": 2, "full": 4, "IQUV": 4}
+
+
 def _td_fit_tile(f1: int, f2: int, f3: int, npol: int, esize: int,
-                 tile_f1: int) -> int:
+                 tile_f1: int, nif: int = 1) -> int:
     """Largest f1-axis tile (a divisor of f1, <= tile_f1) whose blocks fit
     the VMEM budget; 0 when even tile_f1=1 does not (huge f2·f3 panels take
     the unfused path).  Per instance: the planar input pair over
     ``npol*tile`` batch panels, ~6 live f32 scratch panels of the same
-    extent, the f32 output tile, and the constant DFT/twiddle matrices."""
+    extent, the f32 output tile (``nif`` product planes), and the constant
+    DFT/twiddle matrices."""
     consts = (f2 * f2 + f3 * f3 + f2 * f3) * 8
     while tile_f1 >= 1:
-        if f1 % tile_f1 == 0:
+        # The tile sits in the output block's sublane dim: mosaic accepts
+        # it only 8-divisible or covering the whole f1 axis.
+        legal = f1 % tile_f1 == 0 and (tile_f1 % 8 == 0 or tile_f1 == f1)
+        if legal:
             per = npol * tile_f1 * f2 * f3
-            need = consts + per * (2 * esize + 6 * 4) + f2 * f3 * tile_f1 * 4
+            need = (consts + per * (2 * esize + 6 * 4)
+                    + nif * f2 * f3 * tile_f1 * 4)
             if need <= _VMEM_BUDGET:
                 return tile_f1
         tile_f1 //= 2
@@ -154,40 +167,52 @@ def _td_fit_tile(f1: int, f2: int, f3: int, npol: int, esize: int,
 
 
 def tail2_detect_fits(factors, npol: int = 2, esize: int = 2,
-                      tile_f1: int = 16) -> bool:
-    """VMEM-fit gate for :func:`tail2_detect_i` — the check ``channelize``
+                      tile_f1: int = 16, stokes: str = "I") -> bool:
+    """VMEM-fit gate for :func:`tail2_detect` — the check ``channelize``
     runs before resolving the combined pallas tail+detect path."""
-    if len(factors) != 3:
+    if len(factors) != 3 or stokes not in _STOKES_NIF:
+        return False
+    if npol == 1 and stokes not in ("I", "XX"):
         return False
     f1, f2, f3 = factors
-    return _td_fit_tile(f1, f2, f3, npol, esize, tile_f1) > 0
+    return _td_fit_tile(f1, f2, f3, npol, esize, tile_f1,
+                        _STOKES_NIF[stokes]) > 0
 
 
-def _td_kernel(npol, tile, xr_ref, xi_ref, w2r_ref, w2i_ref, w3r_ref,
-               w3i_ref, tr_ref, ti_ref, o_ref):
-    """DFT levels 2+3 + inner untwist + Stokes-I detect, one VMEM pass.
+def _td_kernel(npol, tile, stokes, xr_ref, xi_ref, w2r_ref, w2i_ref,
+               w3r_ref, w3i_ref, tr_ref, ti_ref, o_ref):
+    """DFT levels 2+3 + inner untwist + Stokes detect, one VMEM pass.
 
     Blocks: x (1, npol, 1, tile_f1, f2, f3) planar stage-1 row panels;
-    o (1, 1, f3, tile_f1, f2) — natural order up to ONE final lane swap
-    (f1 ⇄ f2) that the caller leaves to XLA.  Mosaic requires the last two
-    block dims be (8, 128)-divisible or full: f1 is tiled, so it cannot
-    sit in the lane dim, and lane-slice stores into a resident full-f1
-    block need 128-aligned offsets — keeping f2 (=128 at the production
-    shape) as the lane axis satisfies both, and the leftover swap is in
-    XLA's fastest transpose class (the 2D-tile swaps it lowers at
-    ~460 GB/s, DESIGN.md §9) rather than the slow fused detect pass.  The
-    DFT body is pallas_dft._tail2_kernel's (batched dots and transposes
-    only — mosaic rejects reshapes that collapse transposed vector axes);
-    the epilogue squares and sums the polarization pairs.
+    o (1, nif, 1, f3, tile_f1, f2) — natural order up to ONE final lane
+    swap (f1 ⇄ f2) that the caller leaves to XLA.  Mosaic requires the
+    last two block dims be (8, 128)-divisible or full: f1 is tiled, so it
+    cannot sit in the lane dim, and lane-slice stores into a resident
+    full-f1 block need 128-aligned offsets — keeping f2 (=128 at the
+    production shape) as the lane axis satisfies both, and the leftover
+    swap is in XLA's fastest transpose class rather than the slow fused
+    detect pass (DESIGN.md §9).  The DFT body is
+    pallas_dft._tail2_kernel's (batched dots and transposes only —
+    mosaic rejects reshapes that collapse transposed vector axes); the
+    epilogue forms the detection product planes
+    (detect_stokes_planar's table) from the per-pol spectra.
     """
-    xr4 = xr_ref[0, :, 0].astype(jnp.float32)  # (npol, tile, f2, f3)
-    xi4 = xi_ref[0, :, 0].astype(jnp.float32)
+    # bf16 mode runs the dots at the MXU's full (4x) rate.  Accuracy: the
+    # bf16-STORED spectra lose nothing (their products are exact in the
+    # f32 accumulator), but the f32 DFT matrices and the post-twiddle
+    # intermediates ARE rounded to bf16 first — the same operand rounding
+    # XLA's precision=None einsums apply, i.e. default-precision grade,
+    # not bit-identical to all-f32 dots.  The twiddle multiply stays f32
+    # on the VPU.
+    dot_dtype = xr_ref.dtype if xr_ref.dtype == jnp.bfloat16 else jnp.float32
+    xr4 = xr_ref[0, :, 0].astype(dot_dtype)  # (npol, tile, f2, f3)
+    xi4 = xi_ref[0, :, 0].astype(dot_dtype)
     _, _, f2, f3 = xr4.shape
     b = npol * tile
     xr = xr4.reshape(b, f2, f3)  # leading-axis collapse only: mosaic-safe
     xi = xi4.reshape(b, f2, f3)
-    w2r = w2r_ref[...]
-    w2i = w2i_ref[...]
+    w2r = w2r_ref[...].astype(dot_dtype)
+    w2i = w2i_ref[...].astype(dot_dtype)
 
     def stage2(w, a):
         # (b, f2l, f3) × (f2k, f2l) → dot layout (b, f3, f2k)
@@ -204,10 +229,10 @@ def _td_kernel(npol, tile, xr_ref, xi_ref, w2r_ref, w2i_ref, w3r_ref,
     si = (ri + ir).transpose(0, 2, 1)
     tr = tr_ref[...][None]
     ti = ti_ref[...][None]
-    ur = sr * tr - si * ti
-    ui = sr * ti + si * tr
-    w3r = w3r_ref[...]
-    w3i = w3i_ref[...]
+    ur = (sr * tr - si * ti).astype(dot_dtype)
+    ui = (sr * ti + si * tr).astype(dot_dtype)
+    w3r = w3r_ref[...].astype(dot_dtype)
+    w3i = w3i_ref[...].astype(dot_dtype)
 
     def stage3(a, w):
         # (b, f2, f3j) × (f3j, f3k) → (b, f2, f3k)
@@ -220,43 +245,69 @@ def _td_kernel(npol, tile, xr_ref, xi_ref, w2r_ref, w2i_ref, w3r_ref,
     bi = stage3(ui, w3i)
     br = stage3(ui, w3r)
     ai = stage3(ur, w3i)
-    vr = ar - bi  # (b, f2, f3) — axes (k2, k3)
-    vi = br + ai
-    p = vr * vr + vi * vi
-    # Stokes I across the polarization pair: expand the collapsed batch
-    # axis back out and sum.  (Leading-axis reshape: mosaic-safe.)
-    p = p.reshape(npol, tile, f2, f3).sum(axis=0)  # (tile, f2, f3)
+    # (npol, tile, f2, f3) — leading-axis reshape: mosaic-safe.
+    vr = (ar - bi).reshape(npol, tile, f2, f3)
+    vi = (br + ai).reshape(npol, tile, f2, f3)
+    if npol == 1:
+        planes = [vr[0] * vr[0] + vi[0] * vi[0]]  # "I"/"XX"
+    else:
+        pxr, pyr = vr[0], vr[1]
+        pxi, pyi = vi[0], vi[1]
+        xx = pxr * pxr + pxi * pxi
+        yy = pyr * pyr + pyi * pyi
+        if stokes == "I":
+            planes = [xx + yy]
+        elif stokes == "XX":
+            planes = [xx]
+        elif stokes == "YY":
+            planes = [yy]
+        elif stokes == "XXYY":
+            planes = [xx, yy]
+        else:
+            # X·conj(Y) cross products (detect_stokes_planar).
+            xy_re = pxr * pyr + pxi * pyi
+            xy_im = pxi * pyr - pxr * pyi
+            if stokes == "full":
+                planes = [xx, yy, xy_re, xy_im]
+            else:  # IQUV
+                planes = [xx + yy, xx - yy, 2 * xy_re, -2 * xy_im]
     # Natural order within a coarse channel is (k3, k2, k1); the block
     # keeps f2 in the lane dim — (f3, tile_f1, f2) — and the caller's
     # final XLA swap moves k1 innermost.
-    o_ref[0, 0] = jnp.transpose(p, (2, 0, 1))
+    for i, p in enumerate(planes):
+        o_ref[0, i, 0] = jnp.transpose(p, (2, 0, 1))
 
 
-def tail2_detect_i(
+def tail2_detect(
     ur: jax.Array,
     ui: jax.Array,
     f2: int,
     f3: int,
     *,
+    stokes: str = "I",
     tile_f1: int = 16,
     interpret: bool = False,
 ) -> jax.Array:
-    """Fused DFT tail (levels 2+3 + inner untwist) + Stokes-I detection.
+    """Fused DFT tail (levels 2+3 + inner untwist) + Stokes detection.
 
     Consumes the stage-1 outputs of blit/ops/pallas_pfb.pfb_dft1 and
-    returns the detected power in the channelizer's product layout — the
+    returns the detected product planes in the channelizer's layout — the
     bf16 tail spectra never hit HBM, and of the unfused path's three
     post-stage-1 passes (untwist, detect, product transpose) only one
     cheap XLA lane swap remains (the reference's detect runs in rawspec
-    off-chip; here it is the epilogue of the last DFT pass).
+    off-chip; here it is the epilogue of the last DFT pass).  All of
+    detect_stokes_planar's products are supported — the polarization pair
+    is already resident in the block, so cross products (full/IQUV) cost
+    only the extra output planes.
 
     Args:
       ur, ui: ``(nchan, npol, nframes, f1, m)`` planar stage-1 spectra
         with ``m = f2·f3`` (f32 or bf16).
       f2, f3: the remaining Cooley-Tukey factors.
+      stokes: detection product (see ``detect_stokes_planar``).
 
-    Returns f32 ``(nframes, nchan, f1·m)`` natural-order Stokes-I power
-    — frame-major, ready to reshape to the ``(time, nif, chan)`` product.
+    Returns f32 ``(nframes, nif, nchan, f1·m)`` natural-order product
+    planes — frame-major, ready to reshape to ``(time, nif, chan)``.
     """
     from jax.experimental import pallas as pl
 
@@ -264,11 +315,16 @@ def tail2_detect_i(
 
     nchan, npol, nframes, f1, m = ur.shape
     if m != f2 * f3:
-        raise ValueError(f"tail2_detect_i: last axis {m} != {f2}*{f3}")
-    tile = _td_fit_tile(f1, f2, f3, npol, ur.dtype.itemsize, tile_f1)
+        raise ValueError(f"tail2_detect: last axis {m} != {f2}*{f3}")
+    if stokes not in _STOKES_NIF:
+        raise ValueError(f"unknown stokes {stokes!r}")
+    if npol == 1 and stokes not in ("I", "XX"):
+        raise ValueError(f"stokes={stokes!r} needs 2 pols, got 1")
+    nif = _STOKES_NIF[stokes]
+    tile = _td_fit_tile(f1, f2, f3, npol, ur.dtype.itemsize, tile_f1, nif)
     if tile == 0:
         raise ValueError(
-            f"tail2_detect_i: ({f2}, {f3}) panels exceed the VMEM budget — "
+            f"tail2_detect: ({f2}, {f3}) panels exceed the VMEM budget — "
             "use the unfused tail (channelize tail_kernel='xla')"
         )
     ur6 = ur.reshape(nchan, npol, nframes, f1, f2, f3)
@@ -276,13 +332,13 @@ def tail2_detect_i(
     w2r, w2i = (jnp.asarray(a) for a in dft_matrices(f2, "float32"))
     w3r, w3i = (jnp.asarray(a) for a in dft_matrices(f3, "float32"))
     t2r, t2i = (jnp.asarray(a) for a in twiddles(f2, f3, "float32"))
-    kern = functools.partial(_td_kernel, npol, tile)
+    kern = functools.partial(_td_kernel, npol, tile, stokes)
     x_spec = pl.BlockSpec((1, npol, 1, tile, f2, f3),
                           lambda c, t, j: (c, 0, t, j, 0, 0))
     # f2 stays the lane dim (128-divisible or full); the tiled f1 sits in
     # the sublane dim where an 8-divisible tile is legal.
-    o_spec = pl.BlockSpec((1, 1, f3, tile, f2),
-                          lambda c, t, j: (t, c, 0, j, 0))
+    o_spec = pl.BlockSpec((1, nif, 1, f3, tile, f2),
+                          lambda c, t, j: (t, 0, c, 0, j, 0))
     w_spec2 = pl.BlockSpec((f2, f2), lambda c, t, j: (0, 0))
     w_spec3 = pl.BlockSpec((f3, f3), lambda c, t, j: (0, 0))
     t_spec = pl.BlockSpec((f2, f3), lambda c, t, j: (0, 0))
@@ -293,7 +349,7 @@ def tail2_detect_i(
                   t_spec, t_spec],
         out_specs=o_spec,
         out_shape=jax.ShapeDtypeStruct(
-            (nframes, nchan, f3, f1, f2), jnp.float32
+            (nframes, nif, nchan, f3, f1, f2), jnp.float32
         ),
         interpret=interpret,
     )(ur6, ui6, w2r, w2i, w3r, w3i, t2r, t2i)
@@ -302,4 +358,13 @@ def tail2_detect_i(
     # per-tile transpose of the same swap was measured SLOWER: 20.2 vs
     # 11.9 ms at the production shape — mosaic's lane⇄sublane relayout
     # loses to XLA's transpose lowering here, so the swap stays in XLA.)
-    return jnp.swapaxes(out, -1, -2).reshape(nframes, nchan, f1 * m)
+    return jnp.swapaxes(out, -1, -2).reshape(nframes, nif, nchan, f1 * m)
+
+
+# Backwards-compatible alias for the Stokes-I-only round-3 entry point.
+def tail2_detect_i(ur, ui, f2, f3, *, tile_f1: int = 16,
+                   interpret: bool = False) -> jax.Array:
+    """Stokes-I :func:`tail2_detect` returning ``(nframes, nchan, n)``."""
+    out = tail2_detect(ur, ui, f2, f3, stokes="I", tile_f1=tile_f1,
+                       interpret=interpret)
+    return out[:, 0]

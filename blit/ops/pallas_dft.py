@@ -166,10 +166,15 @@ def _tail2_kernel(out_dtype,
     """
     # No in-kernel reshapes: mosaic rejects collapses of transposed vector
     # axes — everything rides batched dot_generals and transposes.
-    xr = xr_ref[...].astype(jnp.float32)  # (tile_b, f2, f3)
-    xi = xi_ref[...].astype(jnp.float32)
-    w2r = w2r_ref[...]
-    w2i = w2i_ref[...]
+    # bf16 mode: dots at the MXU's full rate, with the matrices and
+    # post-twiddle intermediates rounded to bf16 operands — XLA
+    # default-precision grade, not bit-identical to all-f32 dots (see
+    # pallas_detect._td_kernel).
+    dot_dtype = xr_ref.dtype if xr_ref.dtype == jnp.bfloat16 else jnp.float32
+    xr = xr_ref[...].astype(dot_dtype)  # (tile_b, f2, f3)
+    xi = xi_ref[...].astype(dot_dtype)
+    w2r = w2r_ref[...].astype(dot_dtype)
+    w2i = w2i_ref[...].astype(dot_dtype)
 
     def stage2(w, a):
         # (b, f2l, f3) × (f2k, f2l) → dot layout (b, f3, f2k)
@@ -188,11 +193,11 @@ def _tail2_kernel(out_dtype,
     # Level-2 twiddle exp(-2πi k2 j3 / (f2 f3)): (f2, f3), broadcast over b.
     tr = tr_ref[...][None]
     ti = ti_ref[...][None]
-    ur = sr * tr - si * ti
-    ui = sr * ti + si * tr
+    ur = (sr * tr - si * ti).astype(dot_dtype)
+    ui = (sr * ti + si * tr).astype(dot_dtype)
     # Stage 3 contracts the f3 (last) axis against the symmetric W3.
-    w3r = w3r_ref[...]
-    w3i = w3i_ref[...]
+    w3r = w3r_ref[...].astype(dot_dtype)
+    w3i = w3i_ref[...].astype(dot_dtype)
 
     def stage3(a, w):
         # (b, f2, f3j) × (f3j, f3k) → (b, f2, f3k)

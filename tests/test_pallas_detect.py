@@ -10,6 +10,7 @@ from blit.ops import channelize as ch  # noqa: E402
 from blit.ops import dft as D  # noqa: E402
 from blit.ops.pallas_detect import (  # noqa: E402
     detect_untwist_i,
+    tail2_detect,
     tail2_detect_i,
 )
 
@@ -77,11 +78,12 @@ class TestTail2Detect:
     """Fully-fused tail+detect (tail2_detect_i): DFT levels 2+3, inner
     untwist, Stokes-I detection and the product transpose in one pass."""
 
-    # (8, 32, 4) with tile_f1=4 spans f1=8 over TWO grid tiles — the j
-    # index-map path the production (128, 128, 64) shape uses.
+    # (16, 8, 8) with tile_f1=8 spans f1=16 over TWO grid tiles — the j
+    # index-map path the production (128, 128, 64) shape uses.  (Tiles
+    # must be 8-divisible or full-f1: mosaic's sublane constraint, which
+    # interpret mode does not enforce but the fit gate must.)
     @pytest.mark.parametrize("factors,tile_f1", [
-        ((8, 32, 4), 16), ((8, 32, 4), 2), ((8, 4, 4), 16),
-        ((16, 8, 8), 4),
+        ((8, 32, 4), 16), ((8, 4, 4), 16), ((16, 8, 8), 8),
     ])
     def test_matches_tail_then_detect(self, factors, tile_f1):
         rng = np.random.default_rng(0)
@@ -101,6 +103,35 @@ class TestTail2Detect:
         assert got.shape == want.shape
         np.testing.assert_allclose(got, want, rtol=1e-5,
                                    atol=1e-4 * np.abs(want).max())
+
+    @pytest.mark.parametrize("stokes", ["XX", "YY", "XXYY", "full", "IQUV"])
+    def test_all_products_match_detect(self, stokes):
+        from blit.ops.channelize import detect_stokes_planar
+
+        rng = np.random.default_rng(2)
+        f1, f2, f3 = 8, 32, 4
+        m = f2 * f3
+        nchan, npol, nframes = 2, 2, 3
+        ur = rng.standard_normal((nchan, npol, nframes, f1, m))
+        ui = rng.standard_normal((nchan, npol, nframes, f1, m))
+        ur = ur.astype(np.float32)
+        ui = ui.astype(np.float32)
+        got = np.asarray(tail2_detect(
+            jnp.asarray(ur), jnp.asarray(ui), f2, f3, stokes=stokes,
+            interpret=True))
+        sr, si = D.dft_tail(jnp.asarray(ur), jnp.asarray(ui), (f1, f2, f3))
+        # dft_tail emits (nchan, npol, nframes, n) — detect's expected
+        # (..., npol, nframes, n) layout — giving (nchan, nif, nframes, n).
+        want = np.asarray(detect_stokes_planar(sr, si, stokes))
+        want = want.transpose(2, 1, 0, 3)  # (nframes, nif, nchan, n)
+        assert got.shape == want.shape
+        np.testing.assert_allclose(got, want, rtol=1e-5,
+                                   atol=1e-4 * np.abs(want).max())
+
+    def test_single_pol_guard(self):
+        ur = jnp.zeros((1, 1, 1, 8, 128), jnp.float32)
+        with pytest.raises(ValueError, match="2 pols"):
+            tail2_detect(ur, ur, 32, 4, stokes="IQUV", interpret=True)
 
     def test_bfloat16_input(self):
         rng = np.random.default_rng(1)
@@ -131,6 +162,23 @@ class TestTail2Detect:
             jnp.asarray(v), h, nfft=nfft, nint=2, fft_method="matmul",
             pfb_kernel="xla"))
         assert a.shape == b.shape
+        np.testing.assert_allclose(a, b, rtol=1e-4,
+                                   atol=1e-2 * np.abs(b).max())
+
+    def test_channelize_fused_iquv_matches(self):
+        # Full-Stokes product through the fused path ("auto" now resolves
+        # to tail2_detect for every detect_stokes_planar product).
+        rng = np.random.default_rng(6)
+        nfft, ntap = 1 << 20, 4
+        v = rng.integers(-40, 40, (1, (ntap + 1) * nfft, 2, 2), np.int8)
+        h = jnp.asarray(ch.pfb_coeffs(ntap, nfft))
+        kw = dict(nfft=nfft, stokes="IQUV", fft_method="matmul")
+        a = np.asarray(ch.channelize(
+            jnp.asarray(v), h, pfb_kernel="fused1", tail_kernel="pallas",
+            detect_kernel="pallas", **kw))
+        b = np.asarray(ch.channelize(jnp.asarray(v), h, pfb_kernel="xla",
+                                     **kw))
+        assert a.shape == b.shape and a.shape[1] == 4
         np.testing.assert_allclose(a, b, rtol=1e-4,
                                    atol=1e-2 * np.abs(b).max())
 
