@@ -19,6 +19,7 @@ Stages (f32 planar, factors (128, 128, 64) for nfft=2^20):
 
 from __future__ import annotations
 
+import os
 import sys
 import time
 
@@ -26,6 +27,8 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from blit.ops import dft as D
 from blit.ops.channelize import dequantize, pfb_coeffs, pfb_frontend, detect_stokes_planar, integrate
@@ -59,7 +62,99 @@ def timed(fn, *args, reps=6):
     return per, out
 
 
+def time_whole(fn, vj, reps: int = 4):
+    """Warm (compile) then time ``reps`` enqueued calls of the whole
+    channelize with one closing fetch (the same tunnel-amortized rule as
+    :func:`timed`).  Returns (seconds_per_call, compile_seconds)."""
+    g = jax.jit(fn)
+    t0 = time.perf_counter()
+    float(g(vj))
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    acc = [g(vj) for _ in range(reps)]
+    float(acc[-1])
+    return (time.perf_counter() - t0) / reps, compile_s
+
+
+def fused_main(nchan: int, frames: int, dtype: str) -> None:
+    """Per-pass decomposition of the FUSED production pipeline (the
+    DESIGN.md §9 post-fusion table): pfb_dft1 → tail2_detect (+ its XLA
+    lane swap, also isolated on a synthetic array) → whole channelize.
+
+    Run:  python tools/roofline.py --fused [nchan frames [dtype]]
+    """
+    from blit.ops.channelize import _MATMUL_ONLY_BACKENDS, channelize
+    from blit.ops.pallas_detect import tail2_detect
+    from blit.ops.pallas_pfb import pfb_dft1
+
+    nfft, ntap, npol = 1 << 20, 4, 2
+    ntime = (ntap - 1 + frames) * nfft
+    esize = 2 if dtype == "bfloat16" else 4
+    rng = np.random.default_rng(0)
+    v = rng.integers(-40, 40, (nchan, ntime, npol, 2), np.int8)
+    vj = jax.block_until_ready(jnp.asarray(v))
+    interp = jax.default_backend() not in _MATMUL_ONLY_BACKENDS
+    factors = D.default_factors(nfft)
+    n1 = factors[0]
+    sign = np.where(np.arange(nfft) % 2 == 0, 1.0, -1.0).astype(np.float32)
+    shifted = jnp.asarray(pfb_coeffs(ntap, nfft) * sign)
+    w1r, w1i = (jnp.asarray(a) for a in D.dft_matrices(n1, "float32"))
+    t1r, t1i = (jnp.asarray(a) for a in D.twiddles(n1, nfft // n1, "float32"))
+
+    E = nchan * npol * frames * nfft
+    plane = E * esize           # one (re or im) stage-1 plane
+    power = E // npol * 4       # the f32 Stokes-I product
+
+    print(f"fused roofline @ nchan={nchan} frames={frames} dtype={dtype}")
+
+    def report(name, seconds, rd, wr):
+        bts = rd + 2 * wr  # + wr: timed()'s on-device scalarization re-read
+        print(f"  {name:<28}{seconds * 1e3:>8.1f} ms  "
+              f"{(rd + wr) / 1e9:>6.2f} GB  {bts / seconds / 1e9:>6.0f} GB/s",
+              flush=True)
+
+    t, (ur, ui) = timed(
+        lambda x: pfb_dft1(x, shifted, w1r, w1i, t1r, t1i, dtype=dtype,
+                           interpret=interp), vj)
+    report("pfb_dft1 (int8->stage-1)", t, v.nbytes, 2 * plane)
+
+    t, td_out = timed(
+        lambda a, b: tail2_detect(a, b, factors[1], factors[2],
+                                  interpret=interp), ur, ui)
+    report("tail2_detect (+lane swap)", t, 2 * plane, power)
+    del td_out
+
+    # The lane swap isolated (same shape/dtype as the kernel's raw output).
+    x = jnp.zeros((frames, nchan, factors[2], factors[0], factors[1]),
+                  jnp.float32)
+    t, sw_out = timed(lambda y: jnp.swapaxes(y, -1, -2).reshape(
+        frames, nchan, nfft), x)
+    report("lane swap alone (xla)", t, power, power)
+    # Free every stage array before the whole-call rerun — pinned planes
+    # at these shapes are exactly the OOM-sensitive HBM margin (§9).
+    del ur, ui, x, sw_out
+
+    def whole(y):
+        return jnp.sum(channelize(
+            y, jnp.asarray(pfb_coeffs(ntap, nfft)), nfft=nfft, ntap=ntap,
+            nint=1, stokes="I", fft_method="auto",
+            **({} if dtype == "float32" else {"dtype": dtype})))
+
+    whole_t, _compile_s = time_whole(whole, vj)
+    net = frames * nfft * nchan * npol * 2
+    print(f"  whole channelize: {whole_t * 1e3:.1f} ms, net {net / 1e9:.2f} GB"
+          f" -> {net / whole_t / 1e9:.2f} GB/s/chip")
+
+
 def main() -> None:
+    if len(sys.argv) > 1 and sys.argv[1] == "--fused":
+        args = sys.argv[2:]
+        fused_main(
+            int(args[0]) if len(args) > 0 else 48,
+            int(args[1]) if len(args) > 1 else 8,
+            args[2] if len(args) > 2 else "bfloat16",
+        )
+        return
     nchan = int(sys.argv[1]) if len(sys.argv) > 1 else 32
     frames = int(sys.argv[2]) if len(sys.argv) > 2 else 5
     dtype = sys.argv[3] if len(sys.argv) > 3 else "float32"
@@ -216,14 +311,7 @@ def main() -> None:
                                   stokes="I", fft_method="auto",
                                   **({} if dtype == "float32" else {"dtype": dtype})))
 
-    t0 = time.perf_counter()
-    float(whole(vj))
-    compile_s = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    reps = 4
-    acc = [whole(vj) for _ in range(reps)]  # enqueue all, one latency charge
-    float(acc[-1])
-    whole_t = (time.perf_counter() - t0) / reps
+    whole_t, compile_s = time_whole(whole, vj)
 
     net = frames * nfft * nchan * npol * 2  # int8 bytes credited by bench.py
 
