@@ -30,6 +30,38 @@ class TestBitshuffleCore:
         with pytest.raises(ValueError):
             bshuf.bitshuffle(np.zeros(7, np.float32))
 
+    # Sweep the kernel dispatch seams: the AVX2 fast path (elem 1/2/4,
+    # >= 512 elements), the u64-SWAR path (elem 8; short inputs), and the
+    # sub-chunk tails each path hands off (lengths not multiples of the
+    # 512-element staging chunk or the 8-position block step).
+    @pytest.mark.parametrize("esize", [1, 2, 4, 8])
+    @pytest.mark.parametrize("n", [8, 64, 512, 520, 1000, 4104, 1 << 14])
+    def test_shuffle_matches_model_across_paths(self, esize, n):
+        dtype = {1: np.uint8, 2: np.uint16, 4: np.float32, 8: np.float64}[esize]
+        rng = np.random.default_rng(esize * 1000 + n)
+        # Full byte alphabet incl. 0xFF (all-bits-set catches SWAR
+        # mask/carry bugs); compare as raw bytes — float views would let
+        # NaN-payload scrambles and 0.0 sign flips pass assert_array_equal.
+        a = (rng.integers(0, 256, n * esize, dtype=np.uint16)
+             .astype(np.uint8).view(dtype)[:n].copy())
+        np.testing.assert_array_equal(bshuf.bitshuffle(a).view(np.uint8),
+                                      bshuf.bitshuffle_np(a).view(np.uint8))
+        back = bshuf.bitunshuffle(bshuf.bitshuffle(a), dtype, a.size)
+        np.testing.assert_array_equal(back.view(np.uint8), a.view(np.uint8))
+
+    @pytest.mark.parametrize("n", [8, 500, 2048, 2051, 10000, 99999])
+    @pytest.mark.parametrize("dtype", [np.float32, np.int8, np.uint16])
+    def test_chunk_codec_fuzz(self, n, dtype):
+        # Chunk codec round trip across block boundaries, partial last
+        # blocks, and the raw sub-8-element leftover framing.
+        rng = np.random.default_rng(n)
+        a = (rng.integers(-100, 100, n).astype(dtype)
+             if dtype != np.float32
+             else (rng.standard_normal(n) * 50).astype(np.float32))
+        comp = bshuf.compress_chunk(a)
+        back = bshuf.decompress_chunk(comp, dtype, n)
+        np.testing.assert_array_equal(back.view(np.uint8), a.view(np.uint8))
+
     @pytest.mark.parametrize("n", [8, 131, 1000, 4096, 100_000])
     def test_chunk_codec_roundtrip(self, n):
         rng = np.random.default_rng(n)

@@ -62,6 +62,13 @@ def timed(fn, *args, reps=6):
     return per, out
 
 
+def scalarized_bytes(rd: int, wr: int) -> int:
+    """Bytes actually moved when a stage is timed through :func:`timed`'s
+    on-device scalar sink: the harness re-reads the outputs once (+wr).
+    Both report modes must use this same accounting."""
+    return rd + 2 * wr
+
+
 def time_whole(fn, vj, reps: int = 4):
     """Warm (compile) then time ``reps`` enqueued calls of the whole
     channelize with one closing fetch (the same tunnel-amortized rule as
@@ -108,7 +115,7 @@ def fused_main(nchan: int, frames: int, dtype: str) -> None:
     print(f"fused roofline @ nchan={nchan} frames={frames} dtype={dtype}")
 
     def report(name, seconds, rd, wr):
-        bts = rd + 2 * wr  # + wr: timed()'s on-device scalarization re-read
+        bts = scalarized_bytes(rd, wr)
         print(f"  {name:<28}{seconds * 1e3:>8.1f} ms  "
               f"{(rd + wr) / 1e9:>6.2f} GB  {bts / seconds / 1e9:>6.0f} GB/s",
               flush=True)
@@ -176,9 +183,7 @@ def main() -> None:
     rows = []
 
     def row(name, seconds, rd, wr):
-        # + wr again: the timing harness's on-device scalarization re-reads
-        # the stage's outputs once (see timed()).
-        bts = rd + 2 * wr
+        bts = scalarized_bytes(rd, wr)
         rows.append((name, seconds, rd, wr, bts / seconds / 1e9))
         print(f"  {name}: {seconds * 1e3:.1f} ms, {bts / seconds / 1e9:.0f} GB/s",
               flush=True)
