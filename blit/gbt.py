@@ -20,7 +20,12 @@ import numpy as np
 
 from blit import workers as wf
 from blit.config import DEFAULT, SiteConfig, datahosts  # noqa: F401 (re-export)
-from blit.inventory import InventoryRecord, raw_sequences, to_dataframe  # noqa: F401
+from blit.inventory import (  # noqa: F401 (re-exports)
+    InventoryRecord,
+    raw_sequences,
+    scan_grid,
+    to_dataframe,
+)
 from blit.ops.despike import despike as _despike
 from blit.ops.fqav import fqav_range
 from blit.parallel.pool import (  # noqa: F401 (re-export)
@@ -36,6 +41,15 @@ def load_scan_mesh(*args, **kw):
     ICI band stitch); see :func:`blit.parallel.scan.load_scan_mesh`.  Lazy
     wrapper so the host-only API keeps importing without JAX device state."""
     from blit.parallel.scan import load_scan_mesh as _impl
+
+    return _impl(*args, **kw)
+
+
+def reduce_scan_mesh_to_files(*args, **kw):
+    """Windowed mesh reduction streaming each stitched band to a ``.fil``
+    product; see :func:`blit.parallel.scan.reduce_scan_mesh_to_files`.
+    Lazy wrapper, as :func:`load_scan_mesh`."""
+    from blit.parallel.scan import reduce_scan_mesh_to_files as _impl
 
     return _impl(*args, **kw)
 

@@ -153,6 +153,63 @@ def raw_sequences(
     return out
 
 
+def scan_grid(
+    inventories: Iterable,
+    session: str,
+    scan: str,
+) -> Tuple[List[int], List[int], List[List[List[str]]]]:
+    """Resolve one (session, scan)'s RAW recordings into the rectangular
+    ``raw_paths[band][bank]`` grid :func:`blit.parallel.scan.load_scan_mesh`
+    consumes — the bridge from the inventory workflow (the reference's
+    DataFrame groupby on (session, scan), README.md:95-157) to the TPU mesh
+    data plane.
+
+    ``inventories`` is per-worker record lists as :func:`blit.gbt.
+    get_inventories` returns them (``WorkerError`` entries skipped, like the
+    host-side ``load_scan``).  RAW records are grouped into ``.NNNN.raw``
+    sequences (:func:`raw_sequences`); each (band, bank) player must have
+    exactly one sequence for the scan.  The grid is rectangular over the
+    sorted band and bank ids found — a band missing a bank other bands have
+    is an error (the mesh needs one recording per chip), matching
+    ``load_scan_mesh``'s rectangularity requirement.
+
+    Returns ``(band_ids, bank_ids, grid)`` where ``grid[i][j]`` is the
+    sorted path list of band ``band_ids[i]``, bank ``bank_ids[j]``.
+    """
+    from blit.parallel.pool import WorkerError  # lazy: avoid import cycle
+
+    recs = [
+        r
+        for inv in inventories
+        if not isinstance(inv, (WorkerError, Exception))
+        for r in inv
+        if r.session == session and r.scan == scan
+    ]
+    cells: dict = {}
+    for rec, paths in raw_sequences(recs):
+        key = (rec.band, rec.bank)
+        if key in cells:
+            raise ValueError(
+                f"band {rec.band} bank {rec.bank} has multiple RAW sequences "
+                f"for {session}/{scan}: {cells[key][0]} and {paths[0]}"
+            )
+        cells[key] = paths
+    if not cells:
+        raise ValueError(f"no RAW sequences for {session}/{scan} in inventories")
+    band_ids = sorted({b for b, _ in cells})
+    bank_ids = sorted({k for _, k in cells})
+    missing = [
+        (b, k) for b in band_ids for k in bank_ids if (b, k) not in cells
+    ]
+    if missing:
+        raise ValueError(
+            f"{session}/{scan}: players {missing} have no RAW sequence — "
+            f"the (band, bank) grid must be rectangular"
+        )
+    grid = [[cells[(b, k)] for k in bank_ids] for b in band_ids]
+    return band_ids, bank_ids, grid
+
+
 def to_dataframe(inventories: Iterable[Iterable[InventoryRecord]]):
     """Flatten per-worker inventories into one pandas DataFrame — the L4
     analysis-layer workflow from the reference README

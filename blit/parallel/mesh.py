@@ -71,7 +71,7 @@ def filterbank_sharding(mesh: Mesh, stitched: bool) -> NamedSharding:
     jax.jit,
     static_argnames=(
         "mesh", "nfft", "ntap", "nint", "stokes", "fft_method", "stitch",
-        "despike_nfpc",
+        "despike_nfpc", "fqav_by",
     ),
 )
 def band_reduce(
@@ -86,6 +86,7 @@ def band_reduce(
     fft_method: str = "auto",
     stitch: bool = True,
     despike_nfpc: int = 0,
+    fqav_by: int = 1,
 ) -> jax.Array:
     """The full multi-chip reduction step: every chip channelizes its own
     bank's voltage block, then the 8 banks of each band stitch their fine
@@ -100,7 +101,12 @@ def band_reduce(
         When False the product stays frequency-sharded (the SP-like layout)
         and no collective runs at all.
       despike_nfpc: if >= 2, repair each coarse channel's DC fine channel
-        post-stitch (src/gbt.jl:101-111 semantics, vectorized).
+        post-stitch (src/gbt.jl:101-111 semantics, vectorized).  In OUTPUT
+        channel units: with ``fqav_by > 1`` pass ``nfft // fqav_by``.
+      fqav_by: on-device frequency-averaging epilogue applied per chip
+        BEFORE the stitch collective — the reference's reduce-before-the-
+        wire lever (src/gbtworkerfunctions.jl:16-20) mapped onto ICI: the
+        all_gather moves ``fqav_by``x fewer bytes.
 
     Returns:
       float32 ``(nband, ntime_out, nif, nchans)`` where ``nchans`` is the
@@ -118,8 +124,8 @@ def band_reduce(
         # v: (1, 1, nchan, ntime, npol, 2) — this chip's block.
         out = channelize(
             v[0, 0], h, nfft=nfft, ntap=ntap, nint=nint, stokes=stokes,
-            fft_method=fft_method,
-        )  # (t, nif, nchan*nfft)
+            fft_method=fft_method, fqav_by=fqav_by,
+        )  # (t, nif, nchan*nfft//fqav_by)
         if stitch:
             out = jax.lax.all_gather(out, BANK_AXIS, axis=2, tiled=True)
             if despike_nfpc >= 2:

@@ -203,9 +203,17 @@ def build_observation_tree(
     nsamps: int = 16,
     nchans: int = 64,
     kind: str = "fbh5",
+    nfiles: int = 1,
+    raw_ntime: int = 1024,
 ) -> List[str]:
     """A fake BL@GBT data tree: ``<root>/<session>/GUPPI/BLPbb/<guppi name>``
-    with real, readable product files.  Returns created paths."""
+    with real, readable product files.  Returns created paths.
+
+    ``kind="raw"`` writes per-player ``.NNNN.raw`` sequences (``nfiles``
+    members, ``raw_ntime`` samples per block) whose bank frequencies tile
+    contiguously across each band (bank k owns the k-th 187.5/8 MHz slice,
+    descending GBT sign) — so a tree feeds
+    :func:`blit.inventory.scan_grid` / ``load_scan_mesh`` directly."""
     paths = []
     for band, bank in players:
         player = f"BLP{band}{bank}"
@@ -221,8 +229,20 @@ def build_observation_tree(
                 p = os.path.join(d, base + ".rawspec.0002.fil")
                 synth_fil(p, nsamps=nsamps, nchans=nchans, seed=band * 8 + bank)
             elif kind == "raw":
-                p = os.path.join(d, base + ".0000.raw")
-                synth_raw(p, obsnchan=nchans)
+                bank_bw = -187.5 / 8
+                ps, _ = synth_raw_sequence(
+                    os.path.join(d, base),
+                    nfiles=nfiles,
+                    blocks_per_file=2,
+                    obsnchan=nchans,
+                    ntime_per_block=raw_ntime,
+                    seed=band * 8 + bank,
+                    tone_chan=bank % nchans,
+                    obsbw=bank_bw,
+                    obsfreq=8000.0 + band * 500.0 + (bank + 0.5) * bank_bw,
+                )
+                paths.extend(ps)
+                continue
             else:
                 raise ValueError(f"unknown kind {kind!r}")
             paths.append(p)

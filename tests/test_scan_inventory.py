@@ -1,0 +1,197 @@
+"""Inventory-driven mesh workflow (VERDICT r3 item 1): synthetic
+observation tree → get_inventory → scan_grid → load_scan_mesh(session,
+scan) / reduce_scan_mesh_to_files, golden-tested against the host
+pipeline — the reference's whole-scan call shape (``loadscan(session,
+scan, suffix)``, src/gbt.jl:99) driving the TPU data plane."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from blit.inventory import get_inventory, scan_grid  # noqa: E402
+from blit.io.sigproc import read_fil_data  # noqa: E402
+from blit.ops.fqav import fqav_range  # noqa: E402
+from blit.parallel.scan import (  # noqa: E402
+    load_scan_mesh,
+    reduce_scan_mesh_to_files,
+)
+from blit.pipeline import RawReducer  # noqa: E402
+from blit.testing import build_observation_tree  # noqa: E402
+
+SESSION = "AGBT22B_999_01"
+SCAN = "0011"
+NFFT, NINT = 64, 2
+PLAYERS = ((0, 0), (0, 1), (0, 2), (0, 3))
+
+
+@pytest.fixture(scope="module")
+def tree(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("datax"))
+    build_observation_tree(
+        root, session=SESSION, scans=(SCAN, "0012"), players=PLAYERS,
+        kind="raw", nchans=2, nfiles=2, raw_ntime=512,
+    )
+    invs = [get_inventory(file_re=r"\.raw$", root=root)]
+    return root, invs
+
+
+def host_golden(invs, fqav_by=1):
+    """Per-bank RawReducer over the same sequences, channel-concatenated."""
+    _, _, grid = scan_grid(invs, SESSION, SCAN)
+    banks = []
+    for paths in grid[0]:
+        red = RawReducer(nfft=NFFT, nint=NINT, fqav_by=fqav_by)
+        _, d = red.reduce(paths)
+        banks.append(d)
+    return np.concatenate(banks, axis=-1)
+
+
+class TestScanGrid:
+    def test_grid_shape_and_band_ids(self, tree):
+        _, invs = tree
+        band_ids, bank_ids, grid = scan_grid(invs, SESSION, SCAN)
+        assert band_ids == [0] and bank_ids == [0, 1, 2, 3]
+        assert len(grid) == 1 and len(grid[0]) == 4
+        # Each cell is the full 2-file .NNNN.raw sequence, sorted.
+        for k, paths in enumerate(grid[0]):
+            assert len(paths) == 2
+            assert paths == sorted(paths)
+            assert f"BLP0{k}/" in paths[0]
+
+    def test_scan_filter(self, tree):
+        _, invs = tree
+        b12, _, g12 = scan_grid(invs, SESSION, "0012")
+        assert b12 == [0]
+        assert g12[0][0] != scan_grid(invs, SESSION, SCAN)[2][0][0]
+
+    def test_unknown_scan_rejected(self, tree):
+        _, invs = tree
+        with pytest.raises(ValueError, match="no RAW sequences"):
+            scan_grid(invs, SESSION, "9999")
+
+    def test_ragged_grid_rejected(self, tree):
+        _, invs = tree
+        # A second band missing one bank the first has: the (band, bank)
+        # rectangle has a hole.  (Dropping a bank from EVERY band just
+        # shrinks the grid — only cross-band raggedness is an error.)
+        fake_band1 = [
+            r._replace(band=1, file=r.file.replace("BLP0", "BLP1"))
+            for r in invs[0]
+            if r.bank != 3
+        ]
+        with pytest.raises(ValueError, match="rectangular"):
+            scan_grid([invs[0] + fake_band1], SESSION, SCAN)
+
+    def test_worker_error_entries_skipped(self, tree):
+        # The REAL captured-failure type (a dataclass, not an Exception):
+        # get_inventories(on_error="capture") returns these inline.
+        from blit.parallel.pool import WorkerError
+
+        _, invs = tree
+        dead = WorkerError(worker=9, host="blc99",
+                           error=RuntimeError("worker died"))
+        band_ids, _, _ = scan_grid(invs + [dead], SESSION, SCAN)
+        assert band_ids == [0]
+
+
+class TestLoadScanMeshFromInventory:
+    def test_matches_host_pipeline(self, tree):
+        _, invs = tree
+        hdr, out = load_scan_mesh(
+            SESSION, SCAN, inventories=invs, nfft=NFFT, nint=NINT,
+            despike=False,
+        )
+        got = np.asarray(out)
+        want = host_golden(invs)[: got.shape[1]]
+        assert hdr["nchans"] == want.shape[-1] == got.shape[-1]
+        np.testing.assert_allclose(got[0], want, rtol=1e-4, atol=0.5)
+
+    def test_session_form_needs_inventories(self):
+        with pytest.raises(ValueError, match="session-form"):
+            load_scan_mesh(SESSION, SCAN, nfft=NFFT)
+
+    def test_explicit_grid_rejects_inventories(self, tree):
+        _, invs = tree
+        with pytest.raises(ValueError, match="explicit raw_paths"):
+            load_scan_mesh([["x.raw"]], inventories=invs, nfft=NFFT)
+
+
+class TestMeshFqav:
+    def test_fqav_matches_host(self, tree):
+        _, invs = tree
+        hdr, out = load_scan_mesh(
+            SESSION, SCAN, inventories=invs, nfft=NFFT, nint=NINT,
+            fqav_by=4, despike=False,
+        )
+        got = np.asarray(out)
+        want = host_golden(invs, fqav_by=4)[: got.shape[1]]
+        assert got.shape[-1] == want.shape[-1] == 4 * 2 * NFFT // 4
+        np.testing.assert_allclose(got[0], want, rtol=1e-4, atol=2.0)
+
+    def test_fqav_header_math(self, tree):
+        _, invs = tree
+        h1, _ = load_scan_mesh(SESSION, SCAN, inventories=invs, nfft=NFFT,
+                               nint=NINT, despike=False)
+        h4, _ = load_scan_mesh(SESSION, SCAN, inventories=invs, nfft=NFFT,
+                               nint=NINT, fqav_by=4, despike=False)
+        fch1, foff, nchans = fqav_range(
+            h1["fch1"], h1["foff"], h1["nchans"], 4
+        )
+        assert h4["foff"] == pytest.approx(foff)
+        assert h4["fch1"] == pytest.approx(fch1)
+        assert h4["nchans"] == nchans and h4["nfpc"] == NFFT // 4
+        # Same total band span either way.
+        assert abs(h4["foff"]) * h4["nchans"] == pytest.approx(
+            abs(h1["foff"]) * h1["nchans"]
+        )
+
+
+class TestReduceScanMeshToFiles:
+    def test_windowed_products_match_unwindowed(self, tree, tmp_path):
+        _, invs = tree
+        hdr, out = load_scan_mesh(
+            SESSION, SCAN, inventories=invs, nfft=NFFT, nint=NINT,
+        )
+        whole = np.asarray(out)
+        written = reduce_scan_mesh_to_files(
+            SESSION, SCAN, inventories=invs, out_dir=str(tmp_path),
+            nfft=NFFT, nint=NINT, window_frames=4,
+        )
+        assert list(written) == [0]
+        path, whdr = written[0]
+        assert path.endswith("band0.fil") and whdr["nsamps"] == whole.shape[1]
+        rhdr, data = read_fil_data(path)
+        assert rhdr["nchans"] == hdr["nchans"]
+        assert rhdr["fch1"] == pytest.approx(hdr["fch1"])
+        np.testing.assert_allclose(
+            np.asarray(data), whole[0], rtol=1e-4, atol=0.5
+        )
+
+    def test_fqav_product_matches_host(self, tree, tmp_path):
+        _, invs = tree
+        written = reduce_scan_mesh_to_files(
+            SESSION, SCAN, inventories=invs, out_dir=str(tmp_path),
+            nfft=NFFT, nint=NINT, fqav_by=4, despike=False, window_frames=6,
+        )
+        _, data = read_fil_data(written[0][0])
+        want = host_golden(invs, fqav_by=4)[: data.shape[0]]
+        np.testing.assert_allclose(np.asarray(data), want, rtol=1e-4,
+                                   atol=2.0)
+
+    def test_no_partial_left_behind(self, tree, tmp_path):
+        _, invs = tree
+        reduce_scan_mesh_to_files(
+            SESSION, SCAN, inventories=invs, out_dir=str(tmp_path),
+            nfft=NFFT, nint=NINT,
+        )
+        assert not list(tmp_path.glob("*.partial"))
+
+    def test_max_frames_caps_product(self, tree, tmp_path):
+        _, invs = tree
+        written = reduce_scan_mesh_to_files(
+            SESSION, SCAN, inventories=invs, out_dir=str(tmp_path),
+            nfft=NFFT, nint=NINT, max_frames=4,
+        )
+        _, data = read_fil_data(written[0][0])
+        assert data.shape[0] == 4 // NINT
