@@ -103,7 +103,11 @@ def run_single(config_name: str) -> None:
     except Exception:  # noqa: BLE001 — cache is an optimization, never fatal
         pass
 
-    from blit.ops.channelize import channelize, pfb_coeffs
+    from blit.ops.channelize import (
+        channelize,
+        last_kernel_plan as _last_kernel_plan,
+        pfb_coeffs,
+    )
 
     backend = jax.default_backend()
     nfft, ntap, nint, nchan, frames, K, dtype = _CONFIGS[config_name]
@@ -174,6 +178,9 @@ def run_single(config_name: str) -> None:
             "stokes": "I",
             "dtype": dtype,
             "checksum": total,
+            # What 'auto' dispatch resolved to (ADVICE r3: silent default
+            # changes must be attributable in the recorded numbers).
+            "kernel_plan": _last_kernel_plan(),
         },
     }
     result.update(ingest)

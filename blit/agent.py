@@ -106,6 +106,16 @@ class _RestrictedUnpickler(pickle.Unpickler):
         )
 
 
+def ping() -> str:
+    """Liveness probe: the cheapest possible round trip through the full
+    request path (framing, unpickle, resolve, reply).  The client's health
+    check (:meth:`blit.parallel.remote.RemoteWorker._ensure`) calls this as
+    an ordinary ``blit.agent.ping`` request — a wedged-but-alive agent that
+    cannot answer it within the ping deadline is killed and respawned
+    (SURVEY.md §5 "health-checked worker pool")."""
+    return "pong"
+
+
 def resolve(fn_path: str):
     """Import and return a callable from a ``blit.``-prefixed dotted path."""
     if not fn_path.startswith("blit."):

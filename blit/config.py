@@ -60,6 +60,15 @@ class SiteConfig:
     # Worker-pool backend: "local" | "thread" | "process" (plugin boundary per
     # BASELINE.json: a backend flag swaps the worker pool implementation).
     backend: str = "thread"
+    # Worker liveness deadlines (remote backend; SURVEY.md §5 "health-checked
+    # worker pool"): per-call reply deadline and the agent-reuse ping
+    # deadline.  The call deadline must sit ABOVE any legitimate single
+    # call — a whole-scan reduce_raw can run tens of minutes (bench.py
+    # budgets 1500 s for ONE channelize attempt on the dev rig), and a
+    # deadline that fires on healthy work kills the agent mid-write.
+    # None = block forever (the reference's fetch behavior).
+    call_timeout: Optional[float] = 3600.0
+    ping_timeout: Optional[float] = 30.0
 
     def __post_init__(self):
         if self.hosts is None:

@@ -277,6 +277,18 @@ def integrate(power: jax.Array, nint: int) -> jax.Array:
     return power.reshape(shape).sum(axis=-2)
 
 
+# Kernel resolution of the most recent channelize trace (see the
+# assignment inside channelize; read via last_kernel_plan()).
+_LAST_PLAN: dict = {}
+
+
+def last_kernel_plan() -> dict:
+    """The kernel plan the most recent :func:`channelize` TRACE resolved
+    ('auto' dispatch made concrete: which pallas fusions ran).  Empty until
+    a trace happens; a jit cache hit does not refresh it."""
+    return dict(_LAST_PLAN)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(
@@ -541,6 +553,24 @@ def channelize(
             "tail_kernel='pallas' needs pfb_kernel='fused1', exactly 3 "
             "DFT factors, and panel sizes inside the VMEM budget"
         )
+
+    # Record what "auto" resolved to — 'auto' silently upgraded to the
+    # fused kernels in round 3, so output diffs against older runs must be
+    # attributable (ADVICE r3).  Trace-time only: a jit cache hit does not
+    # re-run this body, so the record describes the most recent TRACE
+    # (bench.py surfaces it in its JSON metadata).
+    _LAST_PLAN.clear()
+    _LAST_PLAN.update(
+        fft_method=resolved,
+        pfb_kernel=pfb_kernel,
+        tail_kernel=("tail2_detect" if use_td
+                     else "dft_tail2" if use_pallas_tail else "xla"),
+        detect_kernel=("tail2_detect" if use_td
+                       else "detect_untwist_i" if use_pallas_detect
+                       else "xla"),
+        dft_order="twisted" if twisted else "natural",
+        dtype=dtype,
+    )
 
     def core(v):
         if use_fused1:

@@ -45,6 +45,11 @@ def _default_sum(a, axis):
         isinstance(a, np.ndarray)
         and a.dtype in (np.float32, np.float64)
         and axis in (-1, a.ndim - 1)
+        # The dot accumulates sequentially/FMA (error ~O(n)) where np.sum
+        # is pairwise (~O(log n)); at production fqav sizes that is noise,
+        # but huge averaging groups keep the better-conditioned reduce
+        # (ADVICE r3).
+        and a.shape[-1] <= 1024
     ):
         # One BLAS pass instead of numpy's small-last-axis reduce loop —
         # measured 6.0 vs 2.4 GB/s at the config-1 shape (the group axis is

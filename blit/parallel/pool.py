@@ -32,6 +32,10 @@ from blit.config import DEFAULT, SiteConfig
 
 log = logging.getLogger("blit.pool")
 
+# Distinguishes "not given" (inherit SiteConfig) from an explicit None
+# (disable the deadline — the reference's blocking behavior).
+_UNSET = object()
+
 
 @dataclass
 class WorkerError:
@@ -64,14 +68,28 @@ class WorkerPool:
         config: SiteConfig = DEFAULT,
         transport: Optional[Callable[[str], Sequence[str]]] = None,
         agent_env: Optional[dict] = None,
+        call_timeout=_UNSET,
+        ping_timeout=_UNSET,
     ):
         """``transport``/``agent_env`` apply to ``backend="remote"`` only:
         ``transport(host)`` returns the agent-spawning command (default:
-        ``remote.ssh_command``); tests pass a local-subprocess transport."""
+        ``remote.ssh_command``); tests pass a local-subprocess transport.
+
+        ``call_timeout``/``ping_timeout`` (remote backend) override the
+        site config's worker liveness deadlines
+        (:class:`blit.parallel.remote.RemoteWorker`); an explicit ``None``
+        DISABLES the deadline (blocking ``fetch``, the reference's
+        behavior) — omit them to inherit the config."""
         if backend not in ("local", "thread", "process", "remote"):
             raise ValueError(f"unknown backend {backend!r}")
         self.backend = backend
         self.config = config
+        self.call_timeout = (
+            config.call_timeout if call_timeout is _UNSET else call_timeout
+        )
+        self.ping_timeout = (
+            config.ping_timeout if ping_timeout is _UNSET else ping_timeout
+        )
         # Worker ids start at 1; id 0 is "the main process" by convention,
         # mirroring Distributed.jl's pid-1 master.
         self.workers: List[_Worker] = [
@@ -89,7 +107,11 @@ class WorkerPool:
 
             make_cmd = transport or ssh_command
             for w in self.workers:
-                w.remote = RemoteWorker(w.host, make_cmd(w.host), env=agent_env)
+                w.remote = RemoteWorker(
+                    w.host, make_cmd(w.host), env=agent_env,
+                    call_timeout=self.call_timeout,
+                    ping_timeout=self.ping_timeout,
+                )
 
     # -- introspection ----------------------------------------------------
     @property
@@ -129,10 +151,18 @@ class WorkerPool:
         argtuples: Sequence[tuple],
         kwargs: Optional[dict] = None,
         on_error: str = "raise",
+        timeout: Optional[float] = None,
     ) -> List[Any]:
         """One call per (worker, argtuple) pair — the reference's
         ``@spawnat worker fn(args...)`` + ``fetch.`` fan-out/fan-in
-        (src/gbt.jl:54-57, 75-78).  Results are ordered like ``wids``."""
+        (src/gbt.jl:54-57, 75-78).  Results are ordered like ``wids``.
+
+        ``timeout`` bounds each fan-in wait (seconds); a late worker
+        raises ``TimeoutError`` (or becomes a ``WorkerError`` under
+        ``on_error="capture"``).  The remote backend's own call deadline
+        also KILLS the wedged agent (blit/parallel/remote.py); for the
+        thread/process backends the abandoned call keeps running to
+        completion in the background — Python offers no safe cancel."""
         if len(wids) != len(argtuples):
             raise ValueError("wids and argtuples must have the same length")
         bad = [w for w in wids if not 1 <= w <= len(self.workers)]
@@ -149,7 +179,7 @@ class WorkerPool:
         results: List[Any] = []
         for wid, fut in zip(wids, futures):
             try:
-                results.append(fut.result())
+                results.append(fut.result(timeout=timeout))
             except Exception as e:  # noqa: BLE001
                 if on_error == "capture":
                     log.warning("worker %d (%s) failed: %s", wid, self.host_of(wid), e)
@@ -163,9 +193,11 @@ class WorkerPool:
         fn: Callable,
         kwargs_per_worker: Optional[Callable[[_Worker], dict]] = None,
         on_error: str = "raise",
+        timeout: Optional[float] = None,
     ) -> List[Any]:
         """Call ``fn`` once on every worker (reference: the getinventories
-        fan-out, src/gbt.jl:54-57)."""
+        fan-out, src/gbt.jl:54-57).  ``timeout`` bounds each fan-in wait as
+        in :meth:`run_on`."""
         futures = []
         for w in self.workers:
             kw = kwargs_per_worker(w) if kwargs_per_worker else {}
@@ -173,7 +205,7 @@ class WorkerPool:
         results = []
         for w, fut in zip(self.workers, futures):
             try:
-                results.append(fut.result())
+                results.append(fut.result(timeout=timeout))
             except Exception as e:  # noqa: BLE001
                 if on_error == "capture":
                     log.warning("worker %d (%s) failed: %s", w.wid, w.host, e)

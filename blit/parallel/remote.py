@@ -21,6 +21,7 @@ import pickle
 import subprocess
 import sys
 import threading
+import time
 from typing import Callable, List, Optional, Sequence
 
 from blit.agent import MAGIC, _SAFE_GLOBALS_RESPONSE, read_msg, write_msg
@@ -93,68 +94,203 @@ class RemoteWorker:
     One outstanding call at a time (guarded by a lock), matching the
     reference's one-``@spawnat``-per-worker usage; the pool's thread
     executor provides cross-worker concurrency.
+
+    Liveness is bounded two ways (SURVEY.md §5 "health-checked worker
+    pool" — the reference's blocking ``fetch`` has neither):
+
+    - every call runs under a ``call_timeout`` deadline enforced by a
+      watchdog that KILLS the agent when it fires (the only way to unblock
+      a read from a wedged-but-alive transport: hung NFS under the worker
+      fn, a stuck ssh, a partitioned network).  The caller gets a
+      ``RemoteError(etype="CallTimeout")`` and the next use respawns.
+    - reusing a live agent first round-trips a ``blit.agent.ping`` under
+      the (much shorter) ``ping_timeout``; an agent that cannot answer is
+      killed and respawned BEFORE the real request is committed to it.
+
+    ``call_timeout=None`` disables the deadline (the reference's blocking
+    behavior); the default is generous — worker functions legitimately
+    stream multi-GB files.
     """
 
     def __init__(self, host: str, command: Optional[Sequence[str]] = None,
-                 env: Optional[dict] = None):
+                 env: Optional[dict] = None,
+                 call_timeout: Optional[float] = 600.0,
+                 ping_timeout: Optional[float] = 30.0,
+                 ping_min_idle: float = 5.0):
         self.host = host
         self.command = list(command) if command else ssh_command(host)
+        self.call_timeout = call_timeout
+        self.ping_timeout = ping_timeout
+        # Skip the reuse-time ping when the agent answered this recently —
+        # a chatty fan-out must not pay 2x the WAN round trips; the ping is
+        # for agents that have sat idle long enough to have wedged.
+        self.ping_min_idle = ping_min_idle
+        self._last_ok = float("-inf")  # monotonic time of last good reply
         self._lock = threading.Lock()
         self._proc: Optional[subprocess.Popen] = None
         self._env = env
 
+    def _spawn(self) -> subprocess.Popen:
+        proc = subprocess.Popen(
+            self.command,
+            stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE,
+            env=self._env,
+        )
+        try:
+            _await_banner(proc.stdout, self.host)
+        except BaseException:
+            # A live-but-unframed process (ssh stuck at a prompt, rc noise
+            # past the scan limit) must not be left as self._proc — the
+            # next call would waste a full ping_timeout probing it.
+            proc.kill()
+            proc.wait()
+            self._proc = None
+            raise
+        self._proc = proc
+        log.info("agent for %s started (pid %d)", self.host, proc.pid)
+        return proc
+
+    def _kill_reap(self, proc: subprocess.Popen) -> None:
+        proc.kill()
+        proc.wait()
+        self._proc = None
+
     def _ensure(self) -> subprocess.Popen:
         if self._proc is None or self._proc.poll() is not None:
-            self._proc = subprocess.Popen(
-                self.command,
-                stdin=subprocess.PIPE,
-                stdout=subprocess.PIPE,
-                env=self._env,
-            )
-            _await_banner(self._proc.stdout, self.host)
-            log.info("agent for %s started (pid %d)", self.host, self._proc.pid)
+            return self._spawn()
+        # Reused agent that has sat idle past ping_min_idle: health-check it
+        # with the cheapest full-path round trip before committing the real
+        # request.  A fresh spawn needs no ping — the banner handshake just
+        # proved the path — and a recently-responsive agent skips it too.
+        if self.ping_timeout and (
+            time.monotonic() - self._last_ok > self.ping_min_idle
+        ):
+            proc = self._proc
+            try:
+                reply = self._transact(
+                    proc, ("blit.agent.ping", (), {}), "ping",
+                    self.ping_timeout,
+                )
+                # ANY well-formed reply proves the agent alive and framed —
+                # including ("err", ...) from an older remote blit without
+                # agent.ping() (killing+respawning there would degrade every
+                # call to a full ssh round trip forever).
+                alive = (
+                    isinstance(reply, tuple) and reply
+                    and reply[0] in ("ok", "err")
+                )
+                if alive and self._proc is proc:
+                    if reply[0] == "err":
+                        log.info(
+                            "%s: remote blit lacks agent.ping (%s); agent "
+                            "alive, continuing", self.host, reply[1],
+                        )
+                    return proc
+                log.warning("%s: unexpected ping reply %r; respawning",
+                            self.host, reply)
+            except RemoteError as e:
+                log.warning("%s: agent failed health check (%s); respawning",
+                            self.host, e.etype)
+            if self._proc is proc:  # _transact may already have reaped it
+                self._kill_reap(proc)
+            return self._spawn()
         return self._proc
 
+    def _transact(self, proc: subprocess.Popen, request: tuple,
+                  fn_path: str, timeout: Optional[float]):
+        """One write+read exchange under a kill-on-deadline watchdog.
+
+        Blocking pipe reads cannot be cancelled portably; killing the agent
+        makes them fail with EOF/BrokenPipe, which is mapped to
+        ``CallTimeout`` when the watchdog fired (vs ``AgentDied`` when the
+        agent really died on its own)."""
+        timed_out = threading.Event()
+        done = threading.Event()
+        # Serializes the reply-landed / deadline-fired decision: exactly one
+        # of {done, timed_out} is set first, and the other side observes it
+        # (a bare check-then-kill would let a preempted _fire kill a healthy
+        # agent AFTER the success path declared no timeout).
+        verdict = threading.Lock()
+        timer = None
+        if timeout is not None:
+            def _fire(p=proc):
+                with verdict:
+                    if done.is_set():  # reply landed first; stand down
+                        return
+                    timed_out.set()
+                try:
+                    p.kill()
+                except OSError:
+                    pass
+
+            timer = threading.Timer(timeout, _fire)
+            timer.daemon = True
+            timer.start()
+        try:
+            write_msg(proc.stdin, request)
+            # Responses get the narrower allow-list: no ``re._compile``
+            # (a compromised peer must not hand the client a pathological
+            # regex; results are arrays/records/dicts only).  No drain on
+            # oversize either — the refusal below kills the worker, so
+            # pulling a multi-GiB body through the ssh pipe first would
+            # be pure waste.
+            reply = read_msg(
+                proc.stdout,
+                safe_globals=_SAFE_GLOBALS_RESPONSE,
+                drain_oversized=False,
+            )
+            with verdict:
+                done.set()
+                fired = timed_out.is_set()
+            if fired:
+                # The watchdog fired while the reply was mid-flight: the
+                # reply is whole (the frame read completed) but the agent
+                # is dead — reap it so the next use respawns instead of
+                # surfacing a spurious AgentDied.
+                self._kill_reap(proc)
+            else:
+                self._last_ok = time.monotonic()
+            return reply
+        except (BrokenPipeError, EOFError, OSError) as e:
+            try:
+                rc = proc.wait(timeout=5)  # reap; no zombie
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                rc = proc.wait()
+            self._proc = None
+            if timed_out.is_set():
+                raise RemoteError(
+                    self.host, "CallTimeout",
+                    f"no reply to {fn_path} within {timeout}s; agent killed "
+                    "(will respawn on next use)", "",
+                ) from e
+            raise RemoteError(
+                self.host, "AgentDied",
+                f"agent exited (rc={rc}) during {fn_path}: {e}", "",
+            ) from e
+        except pickle.UnpicklingError as e:
+            # A refused response (oversized / disallowed global) means
+            # the peer is misbehaving or compromised; don't trust the
+            # stream again — kill and respawn on next use.
+            self._kill_reap(proc)
+            raise RemoteError(
+                self.host, "WireRefused",
+                f"response refused during {fn_path}: {e}", "",
+            ) from e
+        finally:
+            if timer is not None:
+                timer.cancel()
+
     def call(self, fn: Callable, *args, **kwargs):
-        """Invoke ``fn`` (a blit callable) on the remote host."""
+        """Invoke ``fn`` (a blit callable) on the remote host, bounded by
+        ``call_timeout``."""
         fn_path = f"{fn.__module__}.{fn.__qualname__}"
         with self._lock:
             proc = self._ensure()
-            try:
-                write_msg(proc.stdin, (fn_path, args, kwargs))
-                # Responses get the narrower allow-list: no ``re._compile``
-                # (a compromised peer must not hand the client a pathological
-                # regex; results are arrays/records/dicts only).  No drain on
-                # oversize either — the refusal below kills the worker, so
-                # pulling a multi-GiB body through the ssh pipe first would
-                # be pure waste.
-                reply = read_msg(
-                    proc.stdout,
-                    safe_globals=_SAFE_GLOBALS_RESPONSE,
-                    drain_oversized=False,
-                )
-            except (BrokenPipeError, EOFError) as e:
-                try:
-                    rc = proc.wait(timeout=5)  # reap; no zombie
-                except subprocess.TimeoutExpired:
-                    proc.kill()
-                    rc = proc.wait()
-                self._proc = None
-                raise RemoteError(
-                    self.host, "AgentDied",
-                    f"agent exited (rc={rc}) during {fn_path}: {e}", "",
-                ) from e
-            except pickle.UnpicklingError as e:
-                # A refused response (oversized / disallowed global) means
-                # the peer is misbehaving or compromised; don't trust the
-                # stream again — kill and respawn on next use.
-                proc.kill()
-                proc.wait()
-                self._proc = None
-                raise RemoteError(
-                    self.host, "WireRefused",
-                    f"response refused during {fn_path}: {e}", "",
-                ) from e
+            reply = self._transact(
+                proc, (fn_path, args, kwargs), fn_path, self.call_timeout
+            )
         if reply[0] == "ok":
             return reply[1]
         _tag, etype, msg, tb = reply

@@ -1,0 +1,27 @@
+"""A wedged-but-alive fake agent: handshakes, consumes requests, never
+replies — the failure mode a hung NFS mount or stuck ssh presents
+(VERDICT r3 weak #1).  ``ANSWER_FIRST=1`` serves the first request
+properly and wedges from the second on, so the client's reuse-time ping
+health check is what trips."""
+
+import os
+import sys
+import time
+
+from blit.agent import MAGIC, read_msg, write_msg
+
+out = sys.stdout.buffer
+out.write(MAGIC)
+out.flush()
+if os.environ.get("ANSWER_FIRST") == "1":
+    read_msg(sys.stdin.buffer)
+    write_msg(out, ("ok", "pong"))
+# Keep consuming requests without ever answering: alive, framed, wedged.
+# (EOF means the client closed the pipe on purpose — exit so pool shutdown
+# stays fast; the watchdog path under test kills us, it never sends EOF.)
+while True:
+    try:
+        read_msg(sys.stdin.buffer)
+    except (EOFError, OSError):
+        sys.exit(0)
+    time.sleep(0)  # stay scheduled; never reply

@@ -283,3 +283,24 @@ class TestOutputHeader:
         assert freqs.mean() == pytest.approx(1500.0, abs=abs(hdr["foff"]))
         span = abs(freqs[-1] - freqs[0]) + abs(hdr["foff"])
         assert span == pytest.approx(187.5)
+
+
+class TestKernelPlan:
+    def test_last_kernel_plan_records_trace_resolution(self):
+        # ADVICE r3: 'auto' dispatch must be attributable.  On CPU the
+        # auto path resolves to XLA kernels; the record reflects the most
+        # recent TRACE (unique shape to force one).
+        from blit.ops.channelize import (
+            channelize, last_kernel_plan, pfb_coeffs,
+        )
+
+        rng = np.random.default_rng(0)
+        v = rng.integers(-8, 8, (3, 7 * 16, 2, 2), dtype=np.int8)
+        channelize(
+            jnp.asarray(v), jnp.asarray(pfb_coeffs(4, 16)), nfft=16,
+        ).block_until_ready()
+        plan = last_kernel_plan()
+        assert plan["pfb_kernel"] == "xla"
+        assert plan["detect_kernel"] == "xla"
+        assert plan["dft_order"] == "natural"
+        assert plan["dtype"] == "float32"

@@ -1,0 +1,217 @@
+"""Health-checked worker pool (SURVEY.md §5; VERDICT r3 item 3): call
+deadlines that KILL a wedged-but-alive agent, reuse-time ping health
+checks, and fan-in timeouts — the liveness bounds the reference's
+blocking ``fetch.`` lacked."""
+
+import os
+import sys
+import time
+
+import pytest
+
+from blit.agent import ping
+from blit.parallel.pool import WorkerError, WorkerPool
+from blit.parallel.remote import (
+    RemoteError,
+    RemoteWorker,
+    agent_env_with_repo,
+    local_agent_command,
+)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def wedged_command():
+    return [sys.executable, os.path.join(HERE, "_wedged_agent.py")]
+
+
+def real_or_wedged_transport(host):
+    return wedged_command() if host == "wedged" else local_agent_command()
+
+
+class TestCallDeadline:
+    def test_wedged_agent_times_out_and_is_killed(self):
+        w = RemoteWorker("wedged", wedged_command(),
+                         env=agent_env_with_repo(), call_timeout=1.0)
+        t0 = time.monotonic()
+        with pytest.raises(RemoteError) as ei:
+            w.call(ping)
+        assert ei.value.etype == "CallTimeout"
+        assert time.monotonic() - t0 < 30
+        # The agent was killed and forgotten: next use respawns.
+        assert w._proc is None
+
+    def test_none_timeout_still_blocks_on_healthy_agent(self):
+        # call_timeout=None is the reference's blocking behavior; a healthy
+        # agent answers and no watchdog interferes.
+        w = RemoteWorker("h", local_agent_command(),
+                         env=agent_env_with_repo(), call_timeout=None)
+        try:
+            assert w.call(ping) == "pong"
+        finally:
+            w.close()
+
+    def test_broadcast_completes_with_live_results(self):
+        # THE VERDICT scenario: one wedged agent must not block the
+        # broadcast — it becomes a WorkerError, the rest stay live.
+        pool = WorkerPool(
+            ["h0", "wedged", "h2"], backend="remote",
+            transport=real_or_wedged_transport,
+            agent_env=agent_env_with_repo(), call_timeout=1.5,
+        )
+        try:
+            res = pool.broadcast(ping, on_error="capture")
+        finally:
+            pool.shutdown()
+        assert res[0] == "pong" and res[2] == "pong"
+        assert isinstance(res[1], WorkerError)
+        assert isinstance(res[1].error, RemoteError)
+        assert res[1].error.etype == "CallTimeout"
+
+
+class TestPingHealthCheck:
+    def test_wedged_reuse_is_respawned(self):
+        # First call answered, then the agent wedges: the reuse-time ping
+        # must detect it, kill it, and respawn — the second call succeeds
+        # on a fresh agent (ANSWER_FIRST serves exactly one request).
+        env = dict(agent_env_with_repo(), ANSWER_FIRST="1")
+        w = RemoteWorker("wedged", wedged_command(), env=env,
+                         call_timeout=5.0, ping_timeout=0.5,
+                         ping_min_idle=0.0)
+        try:
+            assert w.call(ping) == "pong"
+            pid1 = w._proc.pid
+            assert w.call(ping) == "pong"
+            assert w._proc.pid != pid1  # health check forced a respawn
+        finally:
+            w.close()
+
+    def test_healthy_reuse_keeps_agent(self):
+        w = RemoteWorker("h", local_agent_command(),
+                         env=agent_env_with_repo(), ping_timeout=10.0,
+                         ping_min_idle=0.0)
+        try:
+            assert w.call(ping) == "pong"
+            pid1 = w._proc.pid
+            assert w.call(ping) == "pong"
+            assert w._proc.pid == pid1
+        finally:
+            w.close()
+
+    def test_recently_responsive_agent_skips_ping(self, monkeypatch):
+        # Within ping_min_idle of a good reply the probe round trip is
+        # skipped (a chatty fan-out must not pay double WAN latency).
+        w = RemoteWorker("h", local_agent_command(),
+                         env=agent_env_with_repo(), ping_timeout=10.0,
+                         ping_min_idle=60.0)
+        try:
+            assert w.call(ping) == "pong"
+            calls = []
+            orig = w._transact
+
+            def spy(proc, request, fn_path, timeout):
+                calls.append(fn_path)
+                return orig(proc, request, fn_path, timeout)
+
+            monkeypatch.setattr(w, "_transact", spy)
+            assert w.call(ping) == "pong"
+            assert calls == ["blit.agent.ping"]  # the real call only, no probe
+        finally:
+            w.close()
+
+    def test_err_ping_reply_counts_as_alive(self, monkeypatch):
+        # An older remote blit without agent.ping() answers ("err", ...) —
+        # the agent is alive and framed, so it must NOT be kill+respawned
+        # on every reuse (that would degrade every call to a full ssh
+        # round trip).
+        w = RemoteWorker("h", local_agent_command(),
+                         env=agent_env_with_repo(), ping_timeout=10.0,
+                         ping_min_idle=0.0)
+        try:
+            assert w.call(ping) == "pong"
+            pid1 = w._proc.pid
+            orig = w._transact
+
+            def old_agent(proc, request, fn_path, timeout):
+                if fn_path == "ping":
+                    # What an old agent's resolve() failure looks like.
+                    orig(proc, request, fn_path, timeout)  # keep stream framed
+                    return ("err", "AttributeError",
+                            "module 'blit.agent' has no attribute 'ping'", "")
+                return orig(proc, request, fn_path, timeout)
+
+            monkeypatch.setattr(w, "_transact", old_agent)
+            assert w.call(ping) == "pong"
+            assert w._proc.pid == pid1  # alive: no respawn
+        finally:
+            w.close()
+
+    def test_ping_disabled_skips_probe(self):
+        w = RemoteWorker("h", local_agent_command(),
+                         env=agent_env_with_repo(), ping_timeout=None)
+        try:
+            assert w.call(ping) == "pong"
+            assert w.call(ping) == "pong"
+        finally:
+            w.close()
+
+
+class TestFanInTimeout:
+    def test_thread_backend_timeout_captured(self):
+        pool = WorkerPool(["a", "b"], backend="thread")
+        try:
+            res = pool.run_on(
+                [1, 2], time.sleep, [(1.0,), (0,)], on_error="capture",
+                timeout=0.2,
+            )
+        finally:
+            pool.shutdown()
+        assert isinstance(res[0], WorkerError)
+        assert isinstance(res[0].error, TimeoutError)
+        assert res[1] is None  # time.sleep(0) completed
+
+    def test_timeout_raises_without_capture(self):
+        pool = WorkerPool(["a"], backend="thread")
+        try:
+            with pytest.raises(TimeoutError):
+                pool.run_on([1], time.sleep, [(1.0,)], timeout=0.2)
+        finally:
+            pool.shutdown()
+
+
+class TestConfigPlumbing:
+    def test_pool_defaults_from_config(self):
+        from blit.config import DEFAULT
+
+        pool = WorkerPool(["a"], backend="local")
+        try:
+            assert pool.call_timeout == DEFAULT.call_timeout == 3600.0
+            assert pool.ping_timeout == DEFAULT.ping_timeout == 30.0
+        finally:
+            pool.shutdown()
+
+    def test_pool_override_reaches_remote_worker(self):
+        pool = WorkerPool(
+            ["h"], backend="remote", transport=real_or_wedged_transport,
+            agent_env=agent_env_with_repo(), call_timeout=123.0,
+            ping_timeout=7.0,
+        )
+        try:
+            rw = pool.workers[0].remote
+            assert rw.call_timeout == 123.0 and rw.ping_timeout == 7.0
+        finally:
+            pool.shutdown()
+
+    def test_explicit_none_disables_deadlines(self):
+        # None must mean "disable" (blocking fetch), not "inherit config".
+        pool = WorkerPool(
+            ["h"], backend="remote", transport=real_or_wedged_transport,
+            agent_env=agent_env_with_repo(), call_timeout=None,
+            ping_timeout=None,
+        )
+        try:
+            rw = pool.workers[0].remote
+            assert pool.call_timeout is None and rw.call_timeout is None
+            assert pool.ping_timeout is None and rw.ping_timeout is None
+        finally:
+            pool.shutdown()

@@ -38,8 +38,16 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main(argv) -> int:
-    kw_a = json.loads(argv[1])
-    kw_b = json.loads(argv[2])
+    if len(argv) < 3:
+        print(__doc__, file=sys.stderr)
+        print("error: need two JSON kwarg variants", file=sys.stderr)
+        return 2
+    try:
+        kw_a = json.loads(argv[1])
+        kw_b = json.loads(argv[2])
+    except json.JSONDecodeError as e:
+        print(f"error: variant is not valid JSON: {e}", file=sys.stderr)
+        return 2
     nchan = int(argv[3]) if len(argv) > 3 else 48
     frames = int(argv[4]) if len(argv) > 4 else 8
     dtype = argv[5] if len(argv) > 5 else "bfloat16"

@@ -131,7 +131,10 @@ def fused_main(nchan: int, frames: int, dtype: str) -> None:
     report("tail2_detect (+lane swap)", t, 2 * plane, power)
     del td_out
 
-    # The lane swap isolated (same shape/dtype as the kernel's raw output).
+    # The lane swap isolated — models the Stokes-I case: tail2_detect's raw
+    # output carries a nif axis (frames, nif, nchan, f3, f1, f2) which is
+    # size 1 for "I" and folds away here; multi-pol products (nif=4) move
+    # proportionally more bytes than this probe measures (ADVICE r3).
     x = jnp.zeros((frames, nchan, factors[2], factors[0], factors[1]),
                   jnp.float32)
     t, sw_out = timed(lambda y: jnp.swapaxes(y, -1, -2).reshape(
