@@ -215,6 +215,186 @@ def _write_bitshuffle_chunks(ds, data: np.ndarray) -> None:
         ds.id.write_direct_chunk(corner, bshuf.compress_chunk(block))
 
 
+def _header_attrs(ds, header: Dict) -> None:
+    """Stamp the filterbank header onto the ``data`` dataset (shared by the
+    whole-array and streaming writers; ``data_size``/``nsamps`` are computed
+    on read from the dataset itself)."""
+    for k, v in header.items():
+        if k in ("data_size", "nsamps"):
+            continue  # computed on read
+        if isinstance(v, str):
+            ds.attrs[k] = np.bytes_(v.encode())
+        else:
+            ds.attrs[k] = v
+    ds.attrs["DIMENSION_LABELS"] = np.array(
+        [b"time", b"feed_id", b"frequency"], dtype="S9"
+    )
+
+
+class FBH5Writer:
+    """Streaming FBH5 product writer: append ``(k, nifs, nchans)`` slabs
+    into a time-resizable ``data`` dataset at bounded host memory — the
+    ``.h5`` analog of ``RawReducer.reduce_to_file``'s slab-streamed ``.fil``
+    path (VERDICT r3 item 5: a hi-res product of a long scan must be
+    writable as FBH5, BL's native product format
+    (src/gbtworkerfunctions.jl:141-155), without materializing it).
+
+    Peak residency is one chunk row (``chunks[0]`` spectra) plus one
+    encoded chunk, regardless of scan length.  Bitshuffle chunks are
+    encoded by the native codec and stored via direct-chunk writes exactly
+    as :func:`write_fbh5` does, so a streamed file decodes identically to
+    an in-memory write of the same data.
+
+    Atomicity mirrors the ``.fil`` streaming writer: bytes land in a
+    ``.partial`` sibling and rename onto ``path`` only on a successful
+    :meth:`close` — a crash mid-stream must not leave a valid-looking
+    truncated product.  Use as a context manager; an exception inside the
+    ``with`` removes the partial.
+    """
+
+    def __init__(
+        self,
+        path: str,
+        header: Dict,
+        *,
+        nifs: int,
+        nchans: int,
+        dtype=np.float32,
+        compression: Optional[str] = None,
+        chunks: Optional[Tuple[int, int, int]] = None,
+    ):
+        self.final_path = path
+        self.path = path + ".partial"
+        self.dtype = np.dtype(dtype)
+        self._bitshuffle = False
+        kw = {}
+        if compression == "gzip":
+            kw["compression"] = "gzip"
+        elif compression == "bitshuffle":
+            from blit.io import bshuf
+
+            if not bshuf.available():
+                raise RuntimeError(
+                    "bitshuffle codec unavailable; build blit/native first"
+                )
+            self._bitshuffle = True
+            kw["compression"] = BITSHUFFLE_FILTER_ID
+            kw["compression_opts"] = bshuf.filter_cd_values(
+                self.dtype.itemsize
+            )
+            kw["allow_unknown_filter"] = True
+        elif compression is not None:
+            raise ValueError(f"unknown compression {compression!r}")
+        # A time-resizable dataset must be chunked; default matches
+        # write_fbh5's BL convention (16-spectra rows, whole channel span).
+        self.chunks = tuple(chunks) if chunks else (16, nifs, nchans)
+        if self._bitshuffle and self.chunks[1:] != (nifs, nchans):
+            # The streaming encoder stores one chunk per time row (corner
+            # (t, 0, 0)); channel-split chunks would silently drop data.
+            # write_fbh5 (whole-array) handles those; this writer refuses.
+            raise ValueError(
+                "FBH5Writer with bitshuffle needs whole-spectrum chunks: "
+                f"chunks[1:] must be ({nifs}, {nchans}), got {self.chunks}"
+            )
+        self._h5 = h5py.File(self.path, "w")
+        try:
+            self._h5.attrs["CLASS"] = np.bytes_(b"FILTERBANK")
+            self._h5.attrs["VERSION"] = np.bytes_(b"1.0")
+            self._ds = self._h5.create_dataset(
+                "data",
+                shape=(0, nifs, nchans),
+                maxshape=(None, nifs, nchans),
+                dtype=self.dtype,
+                chunks=self.chunks,
+                **kw,
+            )
+            _header_attrs(self._ds, header)
+        except BaseException:
+            self._h5.close()
+            os.unlink(self.path)
+            raise
+        self.nsamps = 0  # spectra durably in the dataset
+        # Pending partial chunk row (the bitshuffle path buffers up to one;
+        # the plain/gzip paths let libhdf5 chunk and never touch this).
+        self._buf = (
+            np.empty(self.chunks, self.dtype) if self._bitshuffle else None
+        )
+        self._buffered = 0
+
+    def append(self, slab: np.ndarray) -> None:
+        """Append ``(k, nifs, nchans)`` spectra to the time axis."""
+        if slab.ndim != 3 or slab.shape[1:] != self._ds.shape[1:]:
+            raise ValueError(
+                f"append: slab shape {slab.shape} does not extend "
+                f"(*, {self._ds.shape[1]}, {self._ds.shape[2]})"
+            )
+        if not self._bitshuffle:
+            k = slab.shape[0]
+            self._ds.resize(self.nsamps + k, axis=0)
+            self._ds[self.nsamps:] = slab
+            self.nsamps += k
+            return
+        slab = np.ascontiguousarray(slab, self.dtype)
+        ct = self.chunks[0]
+        pos = 0
+        while pos < slab.shape[0]:
+            take = min(ct - self._buffered, slab.shape[0] - pos)
+            self._buf[self._buffered:self._buffered + take] = (
+                slab[pos:pos + take]
+            )
+            self._buffered += take
+            pos += take
+            if self._buffered == ct:
+                self._flush_chunk(ct)
+
+    def _flush_chunk(self, rows: int) -> None:
+        """Encode + store the buffered rows as one full chunk (edge chunks
+        zero-padded to full chunk size, as the upstream filter does)."""
+        from blit.io import bshuf
+
+        if rows < self.chunks[0]:
+            self._buf[rows:] = 0
+        corner = (self.nsamps, 0, 0)
+        self._ds.resize(self.nsamps + rows, axis=0)
+        self._ds.id.write_direct_chunk(corner, bshuf.compress_chunk(self._buf))
+        self.nsamps += rows
+        self._buffered = 0
+
+    def close(self) -> None:
+        """Flush any partial tail chunk, finalize, and rename onto the
+        final path.  A failure anywhere in here (tail flush, HDF5 close,
+        rename) drops the ``.partial`` before re-raising — close must
+        never leave a stray partial behind."""
+        if self._h5 is None:
+            return
+        try:
+            if self._bitshuffle and self._buffered:
+                self._flush_chunk(self._buffered)
+            self._h5.close()
+            self._h5 = None
+            os.replace(self.path, self.final_path)
+        except BaseException:
+            self.abort()
+            raise
+
+    def abort(self) -> None:
+        """Drop the partial product (crash/exception path)."""
+        if self._h5 is not None:
+            self._h5.close()
+            self._h5 = None
+        if os.path.exists(self.path):
+            os.unlink(self.path)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, etype, _e, _tb):
+        if etype is None:
+            self.close()
+        else:
+            self.abort()
+
+
 def write_fbh5(
     path: str,
     header: Dict,
@@ -263,13 +443,4 @@ def write_fbh5(
             _write_bitshuffle_chunks(ds, np.ascontiguousarray(data))
         else:
             ds = h5.create_dataset("data", data=data, **kw)
-        for k, v in header.items():
-            if k in ("data_size", "nsamps"):
-                continue  # computed on read
-            if isinstance(v, str):
-                ds.attrs[k] = np.bytes_(v.encode())
-            else:
-                ds.attrs[k] = v
-        ds.attrs["DIMENSION_LABELS"] = np.array(
-            [b"time", b"feed_id", b"frequency"], dtype="S9"
-        )
+        _header_attrs(ds, header)

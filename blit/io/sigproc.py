@@ -123,6 +123,62 @@ def read_fil_data(
     return header, data
 
 
+
+class FilWriter:
+    """Streaming ``.fil`` slab writer with ``.partial`` atomicity — the
+    SIGPROC twin of :class:`blit.io.fbh5.FBH5Writer`'s append interface.
+    SIGPROC derives nsamps from file size, so append-only streaming is
+    exact; bytes land in a ``.partial`` sibling renamed on :meth:`close`
+    (a crash mid-stream must not leave a valid-looking truncated product).
+    Backs both ``RawReducer.reduce_to_file`` and the mesh scan writer
+    (blit/parallel/scan.py) so the atomicity protocol lives in one place.
+    """
+
+    def __init__(self, path: str, header: Dict, nifs: int, nchans: int,
+                 dtype=np.float32):
+        import os as _os
+
+        self.final_path = path
+        self.path = path + ".partial"
+        self._os = _os
+        write_fil(self.path, header, np.zeros((0, nifs, nchans), dtype))
+        self._f = open(self.path, "ab")
+        self.nsamps = 0
+
+    def append(self, slab: np.ndarray) -> None:
+        """Append ``(k, nifs, nchans)`` spectra."""
+        np.ascontiguousarray(slab).tofile(self._f)
+        self.nsamps += slab.shape[0]
+
+    def close(self) -> None:
+        if self._f is None:
+            return
+        try:
+            self._f.close()
+            self._f = None
+            self._os.replace(self.path, self.final_path)
+        except BaseException:
+            self.abort()
+            raise
+
+    def abort(self) -> None:
+        """Drop the partial product (crash/exception path)."""
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+        if self._os.path.exists(self.path):
+            self._os.unlink(self.path)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, etype, _e, _tb):
+        if etype is None:
+            self.close()
+        else:
+            self.abort()
+
+
 def write_fil(path: str, header: Dict, data: np.ndarray) -> None:
     """Write a SIGPROC filterbank file.
 

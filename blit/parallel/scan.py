@@ -291,6 +291,25 @@ def _despike_nfpc(despike: bool, nfft: int, fqav_by: int) -> int:
     return nfpc
 
 
+def _slab_writer(path: str, header: Dict, nif: int, nchans: int,
+                 compression: Optional[str]):
+    """Per-band product writer by extension: ``.h5``/``.hdf5`` streams
+    through :class:`blit.io.fbh5.FBH5Writer` (BL's native product format),
+    anything else through :class:`_FilWriter`.  Both append slabs at
+    bounded memory and land in ``.partial`` siblings renamed on close."""
+    if path.endswith((".h5", ".hdf5")):
+        from blit.io.fbh5 import FBH5Writer
+
+        return FBH5Writer(path, header, nifs=nif, nchans=nchans,
+                          compression=compression)
+    if compression is not None:
+        raise ValueError(".fil products are uncompressed; use .h5 paths "
+                         "with compression=")
+    from blit.io.sigproc import FilWriter
+
+    return FilWriter(path, header, nif, nchans)
+
+
 def load_scan_mesh(
     raw_paths,
     scan: Optional[str] = None,
@@ -409,6 +428,7 @@ def reduce_scan_mesh_to_files(
     despike: bool = True,
     max_frames: Optional[int] = None,
     window_frames: Optional[int] = None,
+    compression: Optional[str] = None,
     mesh=None,
 ) -> Dict[int, Tuple[str, Dict]]:
     """Reduce one scan across the mesh and STREAM each stitched band to a
@@ -425,9 +445,12 @@ def reduce_scan_mesh_to_files(
     Call shapes and reduction parameters match :func:`load_scan_mesh`
     (explicit grid or ``(session, scan, inventories=...)``).
 
-    Output naming: ``out_paths`` (band-ascending, one per band) or
-    ``out_dir`` + ``band<id>.fil`` where ``<id>`` is the real band number
-    from the inventory (grid-row index for an explicit grid).
+    Output naming: ``out_paths`` (band-ascending, one per band; ``.fil``
+    or ``.h5`` per path) or ``out_dir`` + ``band<id>.fil`` (``.h5`` when
+    ``compression`` is set) where ``<id>`` is the real band number from
+    the inventory (grid-row index for an explicit grid).  ``.h5`` products
+    stream through :class:`blit.io.fbh5.FBH5Writer` — BL's native product
+    format — with ``compression`` None | "gzip" | "bitshuffle".
 
     Multi-process pods: each band's file is written by the process owning
     that band row's bank-0 chip (the stitched product is replicated across
@@ -458,8 +481,9 @@ def reduce_scan_mesh_to_files(
     if out_paths is None:
         if out_dir is None:
             raise ValueError("pass out_dir= or out_paths=")
+        ext = "h5" if compression else "fil"
         out_paths = [
-            os.path.join(out_dir, f"band{band_ids[b]}.fil")
+            os.path.join(out_dir, f"band{band_ids[b]}.{ext}")
             for b in range(nband)
         ]
     if len(out_paths) != nband:
@@ -477,8 +501,6 @@ def reduce_scan_mesh_to_files(
         b for b in range(nband)
         if mesh.devices[b, 0].process_index == jax.process_index()
     ]
-    from blit.io.sigproc import write_fil
-
     headers: Dict[int, Dict] = {}
     for b in mine:
         hdr = dict(h0)
@@ -486,26 +508,21 @@ def reduce_scan_mesh_to_files(
         hdr["nchans"] = nchans
         hdr["nifs"] = nif
         headers[b] = hdr
-    tmp_paths = {b: out_paths[b] + ".partial" for b in mine}
     coeffs = jnp.asarray(pfb_coeffs(ntap, nfft, window))
     despike_nfpc = _despike_nfpc(despike, nfft, fqav_by)
-    nsamps = {b: 0 for b in mine}
-    files = {}
+    writers = {}
     try:
         for b in mine:
-            write_fil(
-                tmp_paths[b], headers[b],
-                np.zeros((0, nif, nchans), np.float32),
+            writers[b] = _slab_writer(
+                out_paths[b], headers[b], nif, nchans, compression
             )
-            files[b] = open(tmp_paths[b], "ab")
 
         def flush(out):
             # Blocking readback of one window's stitched bands -> disk.
             by_dev = {s.device: s for s in out.addressable_shards}
             for b in mine:
                 slab = np.asarray(by_dev[mesh.devices[b, 0]].data)[0]
-                np.ascontiguousarray(slab).tofile(files[b])
-                nsamps[b] += slab.shape[0]
+                writers[b].append(np.ascontiguousarray(slab))
 
         # One window in flight: window N+1's host RAW reads + device_put +
         # dispatch happen BEFORE blocking on window N's readback, so host
@@ -537,17 +554,13 @@ def reduce_scan_mesh_to_files(
             f0 += n
         if pending is not None:
             flush(pending)
-        for f in files.values():
-            f.close()
-        files = {}
-        for b in mine:
-            os.replace(tmp_paths[b], out_paths[b])
+        done = {}
+        for b in list(writers):
+            writers[b].close()  # on failure the finally aborts the rest
+            done[b] = writers.pop(b)
     finally:
-        for f in files.values():
-            f.close()
-        for p in tmp_paths.values():
-            if os.path.exists(p):
-                os.unlink(p)
+        for w in writers.values():  # exception path: drop partials
+            w.abort()
     for b in mine:
-        headers[b]["nsamps"] = nsamps[b]
+        headers[b]["nsamps"] = done[b].nsamps
     return {band_ids[b]: (out_paths[b], headers[b]) for b in mine}

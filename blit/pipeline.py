@@ -434,44 +434,50 @@ class RawReducer:
         hdr["nsamps"] = data.shape[0]
         return hdr, data
 
-    def reduce_to_file(self, raw_src: RawSource, out_path: str) -> Dict:
+    def reduce_to_file(self, raw_src: RawSource, out_path: str,
+                       compression: Optional[str] = None) -> Dict:
         """Reduce and write a ``.fil`` or (``.h5``) FBH5 product.
 
-        ``.fil`` products STREAM slab-by-slab to disk (SIGPROC derives
-        nsamps from file size, so append-only writing is exact) — host
-        memory stays at one slab regardless of scan length.  FBH5 output
-        materializes the product first (chunked/compressed layout needs
-        the whole array); use ``.fil`` for scans larger than RAM.
+        Both formats STREAM slab-by-slab to disk at bounded host memory
+        regardless of scan length: ``.fil`` appends raw spectra (SIGPROC
+        derives nsamps from file size), ``.h5`` grows a time-resizable
+        chunked dataset (:class:`blit.io.fbh5.FBH5Writer` — BL's native
+        product format, src/gbtworkerfunctions.jl:141-155).  Either path
+        lands in a ``.partial`` sibling renamed on success.
+
+        ``compression`` applies to ``.h5`` output only: None | "gzip" |
+        "bitshuffle" (BL's production codec, via the native encoder).
         """
         if out_path.endswith((".h5", ".hdf5")):
-            from blit.io.fbh5 import write_fbh5
+            from blit.io.fbh5 import FBH5Writer
 
-            hdr, data = self.reduce(raw_src)
-            write_fbh5(out_path, hdr, data)
+            raw, hdr = self._open_validated(raw_src)
+            nif = STOKES_NIF[self.stokes]
+            with FBH5Writer(
+                out_path, hdr, nifs=nif, nchans=hdr["nchans"],
+                compression=compression,
+            ) as w:
+                for slab in self.stream(raw):
+                    w.append(np.ascontiguousarray(slab))
+            hdr["nsamps"] = w.nsamps
             return hdr
-        from blit.io.sigproc import write_fil
+        if compression is not None:
+            raise ValueError(".fil products are uncompressed; compression "
+                             "applies to .h5 output")
+        from blit.io.sigproc import FilWriter
 
         raw, hdr = self._open_validated(raw_src)
         nif = STOKES_NIF[self.stokes]
-        # Stream into a temp sibling and rename on success: SIGPROC derives
-        # nsamps from file size, so a crash mid-stream would otherwise leave
-        # a VALID-looking truncated product at out_path (silent data loss
-        # for consumers that treat existence as completion).  Resumable
-        # partial products are reduce_resumable's job — there the cursor
-        # sidecar marks incompleteness.
-        tmp_path = out_path + ".partial"
-        write_fil(tmp_path, hdr, np.zeros((0, nif, hdr["nchans"]), np.float32))
-        nsamps = 0
-        try:
-            with open(tmp_path, "ab") as f:
-                for slab in self.stream(raw):
-                    np.ascontiguousarray(slab).tofile(f)
-                    nsamps += slab.shape[0]
-            os.replace(tmp_path, out_path)
-        finally:
-            if os.path.exists(tmp_path):
-                os.unlink(tmp_path)
-        hdr["nsamps"] = nsamps
+        # FilWriter streams into a .partial sibling and renames on success:
+        # SIGPROC derives nsamps from file size, so a crash mid-stream must
+        # not leave a VALID-looking truncated product at out_path (silent
+        # data loss for consumers that treat existence as completion).
+        # Resumable partial products are reduce_resumable's job — there the
+        # cursor sidecar marks incompleteness.
+        with FilWriter(out_path, hdr, nif, hdr["nchans"]) as w:
+            for slab in self.stream(raw):
+                w.append(slab)
+        hdr["nsamps"] = w.nsamps
         return hdr
 
     def reduce_resumable(self, raw_src: RawSource, out_path: str) -> Dict:

@@ -195,3 +195,27 @@ class TestReduceScanMeshToFiles:
         )
         _, data = read_fil_data(written[0][0])
         assert data.shape[0] == 4 // NINT
+
+    def test_h5_product_matches_fil(self, tree, tmp_path):
+        # The mesh writer's .h5 leg (FBH5Writer, bitshuffle) carries the
+        # same payload as the .fil leg.
+        from blit.io.fbh5 import read_fbh5_data, read_fbh5_header
+
+        _, invs = tree
+        fil = reduce_scan_mesh_to_files(
+            SESSION, SCAN, inventories=invs, out_dir=str(tmp_path),
+            nfft=NFFT, nint=NINT, window_frames=4,
+        )
+        h5 = reduce_scan_mesh_to_files(
+            SESSION, SCAN, inventories=invs, out_dir=str(tmp_path),
+            nfft=NFFT, nint=NINT, window_frames=4, compression="bitshuffle",
+        )
+        assert h5[0][0].endswith("band0.h5")
+        _, fdata = read_fil_data(fil[0][0])
+        np.testing.assert_array_equal(
+            read_fbh5_data(h5[0][0]), np.asarray(fdata)
+        )
+        hh = read_fbh5_header(h5[0][0])
+        assert hh["nchans"] == fil[0][1]["nchans"]
+        assert hh["fch1"] == pytest.approx(fil[0][1]["fch1"])
+        assert not list(tmp_path.glob("*.partial"))
