@@ -1,0 +1,115 @@
+"""Child process for the 2-process psum-product pod tests
+(tests/test_multiprocess.py) — VERDICT r3 item 6: beamform and the FX
+correlator executed under ``jax.distributed`` with 2 gloo processes,
+where a sharding mistake becomes a cross-process deadlock instead of a
+wrong answer.
+
+Run as: ``python tests/_mh_psum_child.py <pid> <nproc> <port> [outdir]``
+(outdir accepted for harness uniformity, unused).
+
+Each child builds the SAME tiny deterministic problem from a seeded rng,
+places its addressable shards via ``make_array_from_callback``, runs both
+collectives, and asserts its local shards against the NumPy goldens.
+"""
+
+import sys
+
+
+def main() -> None:
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from blit.parallel.multihost import init_multihost
+
+    active = init_multihost(
+        coordinator_address=f"localhost:{port}",
+        num_processes=nproc,
+        process_id=pid,
+        cpu_collectives="gloo",
+    )
+    assert active and jax.process_count() == nproc
+
+    import numpy as np
+
+    from blit.ops.channelize import pfb_coeffs
+    from blit.parallel.beamform import (
+        antenna_sharding,
+        beamform,
+        beamform_np,
+        weight_sharding,
+    )
+    from blit.parallel.correlator import (
+        correlate,
+        correlate_np,
+        correlator_sharding,
+        visibility_sharding,
+    )
+    from blit.parallel.mesh import make_mesh
+
+    # The pod harness gives each of the 2 processes 4 virtual devices; the
+    # mesh must span ALL of them or one process owns no addressable shard.
+    NBAND, NBANK = 2, 4
+    NANT, NBEAM, NCHAN, NTIME, NPOL = 4, 3, 4, 128, 2
+    NFFT, NTAP, NINT = 16, 4, 2
+    mesh = make_mesh(NBAND, NBANK)
+    rng = np.random.default_rng(7)
+
+    def put(arr, sharding):
+        return jax.make_array_from_callback(
+            arr.shape, sharding, lambda idx: arr[idx]
+        )
+
+    # --- Config 4: tied-array beamform (psum over the antenna axis) -----
+    v = (rng.standard_normal((NANT, NCHAN, NTIME, NPOL))
+         + 1j * rng.standard_normal((NANT, NCHAN, NTIME, NPOL))
+         ).astype(np.complex64)
+    w = (rng.standard_normal((NBEAM, NANT, NCHAN))
+         + 1j * rng.standard_normal((NBEAM, NANT, NCHAN))
+         ).astype(np.complex64)
+    # Antennas sharded over BAND: with this harness's device order each
+    # band row is wholly owned by one process, so only the band axis
+    # crosses the gloo boundary — the antenna psum must ride it or the
+    # test never exercises a cross-process collective.
+    vs = antenna_sharding(mesh, axis="band")
+    ws = weight_sharding(mesh, axis="band")
+    power = beamform(
+        (put(v.real.astype(np.float32), vs), put(v.imag.astype(np.float32), vs)),
+        (put(w.real.astype(np.float32), ws), put(w.imag.astype(np.float32), ws)),
+        mesh=mesh, axis="band", nint=NINT,
+    )
+    golden = beamform_np(v, w, nint=NINT)
+    for s in power.addressable_shards:
+        np.testing.assert_allclose(
+            np.asarray(s.data), golden[s.index], rtol=1e-4, atol=1e-3
+        )
+
+    # --- Config 5: FX correlator (psum over the band/time axis) --------
+    cv = (rng.standard_normal((NANT, NCHAN, NTIME, NPOL))
+          + 1j * rng.standard_normal((NANT, NCHAN, NTIME, NPOL))
+          ).astype(np.complex64)
+    coeffs = pfb_coeffs(NTAP, NFFT).astype(np.float32)
+    cs = correlator_sharding(mesh)
+    visr, visi = correlate(
+        (put(cv.real.astype(np.float32), cs), put(cv.imag.astype(np.float32), cs)),
+        jax.numpy.asarray(coeffs), mesh=mesh, nfft=NFFT, ntap=NTAP,
+    )
+    gvis = correlate_np(cv, coeffs, NFFT, NTAP, nsegments=NBAND)
+    assert visr.sharding.is_equivalent_to(
+        visibility_sharding(mesh), visr.ndim
+    )
+    for s in visr.addressable_shards:
+        np.testing.assert_allclose(
+            np.asarray(s.data), gvis.real[s.index], rtol=1e-3, atol=1e-2
+        )
+    for s in visi.addressable_shards:
+        np.testing.assert_allclose(
+            np.asarray(s.data), gvis.imag[s.index], rtol=1e-3, atol=1e-2
+        )
+
+    print("CHILD-PSUM-OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

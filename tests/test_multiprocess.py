@@ -25,6 +25,7 @@ from blit.testing import synth_raw  # noqa: E402
 
 NBAND, NBANK, NFFT, NINT, NCHAN = 2, 4, 32, 2, 2
 CHILD = os.path.join(os.path.dirname(__file__), "_mh_child.py")
+PSUM_CHILD = os.path.join(os.path.dirname(__file__), "_mh_psum_child.py")
 
 
 def _free_port() -> int:
@@ -52,7 +53,7 @@ def _golden(tmp_path):
     return hdr, np.asarray(out)
 
 
-def _run_pod(outdir, extra_args=()):
+def _run_pod(outdir, extra_args=(), child=CHILD):
     port = _free_port()
     env = dict(os.environ)
     env["PYTHONPATH"] = os.pathsep.join(
@@ -64,7 +65,7 @@ def _run_pod(outdir, extra_args=()):
 
     procs = [
         subprocess.Popen(
-            [sys.executable, CHILD, str(pid), "2", str(port), outdir,
+            [sys.executable, child, str(pid), "2", str(port), outdir,
              *extra_args],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
             text=True,
@@ -135,4 +136,17 @@ def test_pod_player_failure_raises_on_every_process(tmp_path):
         assert rc == 0 and "CHILD-SYMMETRIC-ERROR" in out, (
             f"pod child did not fail symmetrically (rc={rc}):\n"
             f"{out[-500:]}\n{err[-2000:]}"
+        )
+
+
+def test_two_process_psum_products_match_golden(tmp_path):
+    # VERDICT r3 item 6: the psum collectives (beamform config 4, FX
+    # correlator config 5) executed under jax.distributed with 2 gloo
+    # processes — the configuration where a wrong sharding becomes a
+    # cross-process deadlock.  Each child asserts its addressable shards
+    # against the NumPy goldens; any mismatch or hang fails here.
+    outs = _run_pod(str(tmp_path), child=PSUM_CHILD)
+    for rc, out, err in outs:
+        assert rc == 0 and "CHILD-PSUM-OK" in out, (
+            f"psum pod child failed (rc={rc}):\n{err[-3000:]}"
         )
