@@ -392,7 +392,12 @@ def _run_collectives() -> dict:
             return jnp.sum(B.beamform(vp, wp, mesh=mesh, nint=nint))
 
         float(bstep())  # compile
-        K = 4
+        # These calls run ~10 ms each — far below the tunnel's ~100 ms
+        # closing-fetch latency, which K=4 buried the measurement under
+        # (round 3 reported 6.5 GB/s for a ~22 GB/s correlator; the
+        # round-4 roofline caught it, tools/roofline_fx.py).  48 reps
+        # make the amortized fetch share a few percent.
+        K = 48
         # In-order queue: sync the last dispatch only (see run_single).
         t0 = time.perf_counter()
         acc = [bstep() for _ in range(K)]

@@ -82,6 +82,14 @@ def _xengine_planar(sr: jax.Array, si: jax.Array) -> Planar:
     ``V[a,b] = Σ_t S_a S_b*``: with planar S the real part is
     ``Σ (ar·br + ai·bi)`` and the imaginary part ``Σ (ai·br − ar·bi)`` —
     4 real batched einsums (MXU) instead of one complex einsum.
+
+    Measured dead end (DESIGN.md §9 round-4 addendum): computing all four
+    block products as ONE einsum over the re/im-stacked operand (a
+    (2·nant·npol)² matmul per (chan, fine) batch entry, 4x the work per
+    MXU tile) LOSES on the chip — 18.9 vs 20.7 GB/s input rate
+    end-to-end (interleaved A/B, tools/ab_fx.py): the stack's
+    concatenate materializes an extra copy of both spectra planes, and
+    the MXU tiles were not the binding resource.
     """
     rr = jnp.einsum("acptf,bcqtf->abcfpq", sr, sr)
     ii = jnp.einsum("acptf,bcqtf->abcfpq", si, si)

@@ -62,7 +62,6 @@ class TestReadPoolConcurrency:
         # Force the FBH5 decode pool past one worker (the rig has 1 core,
         # so os.cpu_count() would size it to 1 and the concurrent path
         # would never run) and read a many-chunk file back whole.
-        from blit.io import fbh5
         from blit.io.fbh5 import read_fbh5_data, write_fbh5
 
         monkeypatch.setattr(os, "cpu_count", lambda: 4)
@@ -80,7 +79,6 @@ class TestReadPoolConcurrency:
     def test_worker_error_propagates(self, tmp_path, monkeypatch):
         # A decode failure inside the pool must surface, not vanish into
         # a dropped future.
-        from blit.io import fbh5
         from blit.io.fbh5 import read_fbh5_data, write_fbh5
 
         monkeypatch.setattr(os, "cpu_count", lambda: 4)
@@ -90,12 +88,13 @@ class TestReadPoolConcurrency:
         write_fbh5(p, {"fch1": 1.0, "foff": -0.1}, data,
                    compression="bitshuffle", chunks=(4, 1, 64))
 
+        import itertools
+
         real = bshuf.decompress_chunk
-        calls = []
+        counter = itertools.count()  # atomic under the GIL (one bytecode)
 
         def flaky(payload, dtype, n):
-            calls.append(1)
-            if len(calls) == 5:
+            if next(counter) == 4:
                 raise ValueError("synthetic decode failure")
             return real(payload, dtype, n)
 

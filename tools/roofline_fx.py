@@ -15,7 +15,7 @@ once, write outputs once, f32); achieved GB/s divides the sink-inclusive
 bytes (`scalarized_bytes`: timed()'s on-device scalar sink re-reads each
 stage's outputs once), the same convention as tools/roofline.py.
 
-Run on the TPU rig:  python tools/roofline_fx.py [nant nchan nfft nblk]
+Run on the TPU rig:  python tools/roofline_fx.py [nant nchan nfft nblk reps]
 """
 
 from __future__ import annotations
@@ -43,6 +43,11 @@ def main() -> None:
     nchan = int(sys.argv[2]) if len(sys.argv) > 2 else 64
     nfft = int(sys.argv[3]) if len(sys.argv) > 3 else 512
     nblk = int(sys.argv[4]) if len(sys.argv) > 4 else 64
+    # The tunnel charges ~100 ms to the ONE closing fetch; stages here run
+    # ~3-25 ms, so the default 6 reps would bury them in amortized fetch
+    # latency (the filterbank roofline's 36 ms stages tolerate it; these
+    # do not).  High reps make the per-rep latency share negligible.
+    reps = int(sys.argv[5]) if len(sys.argv) > 5 else 32
     ntap, npol = 4, 2
     ntime = nblk * nfft
     nframes = nblk - ntap + 1
@@ -83,16 +88,19 @@ def main() -> None:
 
     # Stage 1: FIR on both planes.
     t, (fr, fi) = timed(
-        lambda a, b: (pfb_frontend(a, hj), pfb_frontend(b, hj)), vr, vi
+        lambda a, b: (pfb_frontend(a, hj), pfb_frontend(b, hj)), vr, vi,
+        reps=reps,
     )
     report("pfb x2 (fir)", t, 2 * plane, 2 * spec)
 
     # Stage 2: planar matmul DFT on the framed planes.
-    t, (sr, si) = timed(lambda a, b: fft_planar(a, b), fr, fi)
+    t, (sr, si) = timed(lambda a, b: fft_planar(a, b), fr, fi,
+                        reps=reps)
     report("dft (planar matmul)", t, 2 * spec, 2 * spec)
 
     # Stage 3: X-engine cross products.
-    t, _ = timed(lambda a, b: C._xengine_planar(a, b), sr, si)
+    t, _ = timed(lambda a, b: C._xengine_planar(a, b), sr, si,
+                 reps=reps)
     report("xengine (4 einsums)", t, 2 * spec, 2 * vis)
     del fr, fi, sr, si
 
@@ -110,7 +118,7 @@ def main() -> None:
         a, b = C.correlate(pair, hplain, mesh=mesh, nfft=nfft, ntap=ntap)
         return jnp.sum(a) + jnp.sum(b)
 
-    sec, compile_s = time_whole(whole, vp)
+    sec, compile_s = time_whole(whole, vp, reps=reps)
     input_bytes = 2 * plane
     print(f"{'whole correlate':24s} {sec * 1e3:8.2f} ms   "
           f"input {input_bytes / 1e6:9.1f} MB   "
