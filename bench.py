@@ -60,20 +60,24 @@ _INGEST_CONFIGS = {
     "cpu": (1 << 14, 4, 4, 4, 11 * (1 << 12)),
 }
 
-# (nfft, ntap, nint, nchan, frames, K calls, dtype)
+# (nfft, ntap, nint, nchan, frames, K calls, dtype).  K follows the
+# rep-count rule (DESIGN.md §9 round-4): K x call-time >> the ~100 ms
+# closing fetch, or the number measures the tunnel.  At 86-90 ms/call,
+# K=24 pins the fetch share under 5% (K=8 cost ~5% and doubled variance:
+# interleaved sweep measured 16.8-17.8 vs a stable 18.73-18.75 GB/s).
 _CONFIGS = {
     # Hi-res product, bf16 stages + fused pallas dequant+PFB: the gross
     # dequant planes never hit HBM, so 48 coarse channels x 8 frames fit
     # per dispatch (interleaved A/B: 48ch 6.2-6.4 vs 32ch 5.8-6.0 GB/s;
     # 64ch OOMs).  Accuracy bound: DESIGN.md §8.
-    "tpu_bf16": (1 << 20, 4, 1, 48, 8, 8, "bfloat16"),
+    "tpu_bf16": (1 << 20, 4, 1, 48, 8, 24, "bfloat16"),
     # f32 flat-layout config: 32 coarse channels x 5 frames of 2^20-point
     # channelization per dispatch (671 MB net per call; measured 4.4 GB/s
     # = 5.8x real-time on a v5e chip in round 2).
-    "tpu": (1 << 20, 4, 1, 32, 5, 8, "float32"),
+    "tpu": (1 << 20, 4, 1, 32, 5, 24, "float32"),
     # Fallback under repeated failures: same hi-res metric, half the
     # working set per dispatch.
-    "tpu_small": (1 << 20, 4, 1, 16, 3, 8, "float32"),
+    "tpu_small": (1 << 20, 4, 1, 16, 3, 24, "float32"),
     # Dev machines (CPU): keep runtime sane.
     "cpu": (1 << 14, 4, 1, 4, 4, 4, "float32"),
 }

@@ -7,6 +7,10 @@ the library (:mod:`blit.gbt` et al.) and this thin command layer over it.
 Commands:
   reduce     GUPPI RAW (file, .NNNN.raw sequence stem, or member list)
              → filterbank product (.fil streams to disk; .h5 = FBH5).
+  scan       Whole (session, scan) across the device mesh: crawl the
+             tree, map every player's RAW sequence onto the (band, bank)
+             mesh, stream each stitched band to a per-band product —
+             the reference's ``loadscan`` (src/gbt.jl:99) as a command.
   inventory  Crawl a data tree (reference getinventory semantics) and
              print records as JSON lines or a table.
   info       Print the normalized header of a .fil / .h5 / .raw file.
@@ -46,6 +50,41 @@ def _cmd_reduce(args: argparse.Namespace) -> int:
             }
         )
     )
+    return 0
+
+
+def _cmd_scan(args: argparse.Namespace) -> int:
+    from blit.inventory import get_inventory
+    from blit.parallel.scan import reduce_scan_mesh_to_files
+
+    invs = [get_inventory(args.file_re or r"\.raw$", root=args.root)]
+    written = reduce_scan_mesh_to_files(
+        args.session,
+        args.scan,
+        inventories=invs,
+        out_dir=args.output_dir,
+        nfft=args.nfft,
+        nint=args.nint,
+        stokes=args.stokes,
+        fqav_by=args.fqav,
+        despike=not args.no_despike,
+        window_frames=args.window_frames,
+        max_frames=args.max_frames,
+        compression=args.compression,
+    )
+    for band, (path, hdr) in sorted(written.items()):
+        print(
+            json.dumps(
+                {
+                    "band": band,
+                    "output": path,
+                    "nsamps": hdr.get("nsamps"),
+                    "nchans": hdr.get("nchans"),
+                    "fch1": hdr.get("fch1"),
+                    "foff": hdr.get("foff"),
+                }
+            )
+        )
     return 0
 
 
@@ -121,6 +160,29 @@ def main(argv: Optional[List[str]] = None) -> int:
     pr.add_argument("--resume", action="store_true",
                     help="crash-resumable streaming (.fil only)")
     pr.set_defaults(fn=_cmd_reduce)
+
+    ps = sub.add_parser(
+        "scan", help="whole (session, scan) → per-band products via the mesh"
+    )
+    ps.add_argument("root", help="data tree root (as `blit inventory`)")
+    ps.add_argument("session", help="e.g. AGBT22B_999_01")
+    ps.add_argument("scan", help="4-digit scan number, e.g. 0011")
+    ps.add_argument("-o", "--output-dir", required=True)
+    ps.add_argument("--file-re", default=None,
+                    help=r"inventory filename filter (default \.raw$)")
+    ps.add_argument("--nfft", type=int, default=1024)
+    ps.add_argument("--nint", type=int, default=1)
+    ps.add_argument("--stokes", default="I")
+    ps.add_argument("--fqav", type=int, default=1,
+                    help="per-chip frequency averaging before the stitch")
+    ps.add_argument("--no-despike", action="store_true")
+    ps.add_argument("--window-frames", type=int, default=None,
+                    help="PFB frames per device window (bounds HBM/host)")
+    ps.add_argument("--max-frames", type=int, default=None)
+    ps.add_argument("--compression", default=None,
+                    choices=["gzip", "bitshuffle"],
+                    help="write .h5 (FBH5) band products with this codec")
+    ps.set_defaults(fn=_cmd_scan)
 
     pi = sub.add_parser("inventory", help="crawl a data tree")
     pi.add_argument("root")

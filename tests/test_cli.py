@@ -86,3 +86,23 @@ class TestInventoryInfo:
         synth_fil(fil, nchans=8)
         rc, txt = run(capsys, "info", fil)
         assert rc == 0 and json.loads(txt)["nchans"] == 8
+
+
+class TestScanCommand:
+    def test_scan_produces_per_band_products(self, tmp_path, capsys):
+        root = str(tmp_path / "datax")
+        build_observation_tree(
+            root, kind="raw", players=((0, 0), (0, 1)), nchans=2,
+            nfiles=2, raw_ntime=512,
+        )
+        rc, txt = run(capsys, "scan", root, "AGBT22B_999_01", "0011",
+                      "-o", str(tmp_path), "--nfft", "64", "--nint", "2",
+                      "--window-frames", "4")
+        assert rc == 0
+        rows = [json.loads(l) for l in txt.strip().splitlines()]
+        assert [r["band"] for r in rows] == [0]
+        from blit.io.sigproc import read_fil_data
+
+        hdr, data = read_fil_data(rows[0]["output"])
+        assert hdr["nchans"] == rows[0]["nchans"] == 2 * 2 * 64
+        assert data.shape[0] == rows[0]["nsamps"] > 0
