@@ -219,3 +219,38 @@ class TestReduceScanMeshToFiles:
         assert hh["nchans"] == fil[0][1]["nchans"]
         assert hh["fch1"] == pytest.approx(fil[0][1]["fch1"])
         assert not list(tmp_path.glob("*.partial"))
+
+    def test_creation_failure_leaves_no_partials(self, tree, tmp_path):
+        _, invs = tree
+        bad = str(tmp_path / "no_such_dir" / "band0.fil")
+        with pytest.raises(FileNotFoundError):
+            reduce_scan_mesh_to_files(
+                SESSION, SCAN, inventories=invs, out_paths=[bad],
+                nfft=NFFT, nint=NINT,
+            )
+        assert not list(tmp_path.rglob("*.partial"))
+
+    def test_midstream_failure_drops_partials(self, tree, tmp_path,
+                                              monkeypatch):
+        # The reduction dying between windows must abort every writer:
+        # no .partial siblings, no valid-looking truncated products.
+        from blit.parallel import mesh as M
+
+        _, invs = tree
+        real = M.band_reduce
+        calls = []
+
+        def flaky(*a, **kw):
+            calls.append(1)
+            if len(calls) == 2:
+                raise RuntimeError("synthetic device failure")
+            return real(*a, **kw)
+
+        monkeypatch.setattr(M, "band_reduce", flaky)
+        with pytest.raises(RuntimeError, match="synthetic device failure"):
+            reduce_scan_mesh_to_files(
+                SESSION, SCAN, inventories=invs, out_dir=str(tmp_path),
+                nfft=NFFT, nint=NINT, window_frames=4,
+            )
+        assert not list(tmp_path.glob("*.partial"))
+        assert not list(tmp_path.glob("*.fil"))

@@ -111,6 +111,34 @@ def run():
     np.testing.assert_allclose(np.asarray(visi), want.imag, rtol=2e-2, atol=2e-1)
     print("correlator: ok")
 
+    # Round 4: the file-fed antenna data plane end-to-end on the real
+    # backend — per-antenna RAW files -> planar device shards -> beamform.
+    import os as _os
+    import tempfile
+
+    from blit.parallel.antenna import load_antennas_mesh
+    from blit.testing import synth_raw
+
+    with tempfile.TemporaryDirectory() as td:
+        paths, cplx = [], []
+        for a in range(nant):
+            p = _os.path.join(td, f"ant{a}.raw")
+            # synth_raw hands back the written blocks: the golden builds
+            # from them directly, independent of the reader under test.
+            _, blocks = synth_raw(p, nblocks=2, obsnchan=nchan,
+                                  ntime_per_block=64, seed=a)
+            stream = np.concatenate(blocks, axis=1)
+            cplx.append(stream[..., 0].astype(np.float32)
+                        + 1j * stream[..., 1].astype(np.float32))
+            paths.append(p)
+        hdr, vp2 = load_antennas_mesh(paths, mesh=mesh)
+        got2 = np.asarray(B.beamform(vp2, wp, mesh=mesh, nint=8))
+        want2 = B.beamform_np(
+            np.stack(cplx)[:, :, :hdr["_ntime"]], w, nint=8
+        )
+    np.testing.assert_allclose(got2, want2, rtol=2e-2, atol=2e-2)
+    print("antenna loader: ok")
+
     # Pallas kernels compile and agree NATIVELY on the chip (the CPU suite
     # only exercises them in interpreter mode): fused dequant+PFB+stage-1
     # and the fused detect+untwist, tiny multi-factor shapes.
@@ -195,4 +223,5 @@ def test_collectives_per_chip_math_runs_on_hardware():
         pytest.skip("hardware smoke infrastructure failure:\n" + blob[-1500:])
     assert "beamform: ok" in proc.stdout
     assert "correlator: ok" in proc.stdout
+    assert "antenna loader: ok" in proc.stdout
     assert "pallas kernels: ok" in proc.stdout
