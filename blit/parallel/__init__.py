@@ -19,7 +19,8 @@ __all__ = ["WorkerError", "WorkerPool", "setup_workers", "current_pool"]
 
 def __getattr__(name):
     # Lazy: mesh/beamform/correlator pull in JAX; pool-only users stay light.
-    if name in ("mesh", "beamform", "correlator"):
+    if name in ("mesh", "beamform", "correlator", "scan", "antenna",
+                "multihost", "remote"):
         import importlib
 
         return importlib.import_module(f"blit.parallel.{name}")

@@ -254,3 +254,30 @@ class TestReduceScanMeshToFiles:
             )
         assert not list(tmp_path.glob("*.partial"))
         assert not list(tmp_path.glob("*.fil"))
+
+
+class TestWindowEquivalenceFuzz:
+    @pytest.mark.parametrize("seed", range(4))
+    def test_random_window_configs_match_unwindowed(self, tree, tmp_path,
+                                                    seed):
+        # Property: for ANY window size, nint, and fqav the windowed
+        # streaming product equals the one-shot mesh reduction (PFB
+        # overlap re-reads, nint-aligned windows, ragged last window).
+        rng = np.random.default_rng(seed)
+        _, invs = tree
+        nint = int(rng.choice([1, 2, 4]))
+        fqav = int(rng.choice([1, 2, 8]))
+        wf = int(rng.integers(1, 9))
+        _, out = load_scan_mesh(
+            SESSION, SCAN, inventories=invs, nfft=NFFT, nint=nint,
+            fqav_by=fqav,
+        )
+        written = reduce_scan_mesh_to_files(
+            SESSION, SCAN, inventories=invs, out_dir=str(tmp_path),
+            nfft=NFFT, nint=nint, fqav_by=fqav, window_frames=wf,
+        )
+        _, data = read_fil_data(written[0][0])
+        np.testing.assert_allclose(
+            np.asarray(data), np.asarray(out)[0], rtol=1e-4, atol=0.5,
+            err_msg=f"nint={nint} fqav={fqav} window_frames={wf}",
+        )
