@@ -71,6 +71,7 @@ def _cmd_scan(args: argparse.Namespace) -> int:
         window_frames=args.window_frames,
         max_frames=args.max_frames,
         compression=args.compression,
+        resume=args.resume,
     )
     for band, (path, hdr) in sorted(written.items()):
         print(
@@ -182,6 +183,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     ps.add_argument("--compression", default=None,
                     choices=["gzip", "bitshuffle"],
                     help="write .h5 (FBH5) band products with this codec")
+    ps.add_argument("--resume", action="store_true",
+                    help="crash-resumable streaming (.fil only; cursor "
+                         "sidecar per band)")
     ps.set_defaults(fn=_cmd_scan)
 
     pi = sub.add_parser("inventory", help="crawl a data tree")

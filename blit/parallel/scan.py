@@ -429,6 +429,7 @@ def reduce_scan_mesh_to_files(
     max_frames: Optional[int] = None,
     window_frames: Optional[int] = None,
     compression: Optional[str] = None,
+    resume: bool = False,
     mesh=None,
 ) -> Dict[int, Tuple[str, Dict]]:
     """Reduce one scan across the mesh and STREAM each stitched band to a
@@ -457,6 +458,18 @@ def reduce_scan_mesh_to_files(
     the row, so one owner suffices and ``out_dir`` may be process-local
     disk).  Returns ``{band_id: (path, header)}`` for the bands THIS
     process wrote.
+
+    ``resume=True`` (``.fil`` products only) makes the stream
+    crash-resumable, the mesh twin of ``RawReducer.reduce_resumable``:
+    a :class:`~blit.pipeline.ReductionCursor` sidecar per band records
+    frames durably written after every window (data fsync'd before the
+    cursor claims it); re-running truncates any un-checkpointed tail and
+    continues from the last window boundary every process agrees on
+    (pod-wide MIN, window-aligned — the restart offset must be identical
+    on every process or the collectives deadlock).  Cursor identity
+    covers the reduction config and this process's locally-fed member
+    files; the finished product is identical to an uninterrupted run and
+    the sidecars are removed on completion.
     """
     import os
 
@@ -510,12 +523,69 @@ def reduce_scan_mesh_to_files(
         headers[b] = hdr
     coeffs = jnp.asarray(pfb_coeffs(ntap, nfft, window))
     despike_nfpc = _despike_nfpc(despike, nfft, fqav_by)
+
+    f0_start = 0
+    cursors = {}
+    if resume:
+        if compression is not None or any(
+            p.endswith((".h5", ".hdf5")) for p in out_paths
+        ):
+            raise ValueError("resume=True writes .fil (appendable) products")
+        from types import SimpleNamespace
+
+        from blit.pipeline import ReductionCursor
+
+        ident = SimpleNamespace(
+            nfft=nfft, ntap=ntap, nint=nint, stokes=stokes, window=window,
+            fqav_by=fqav_by, dtype="float32", despike_nfpc=despike_nfpc,
+        )
+        # This process's fed member files: the input identity a resume
+        # must match (a changed recording would splice different spectra).
+        members = sorted(
+            p
+            for r in raws.values()
+            for p in (getattr(r, "paths", None) or [r.path])
+        )
+        local_done = []
+        for b in mine:
+            cur = ReductionCursor.load(out_paths[b])
+            ok = (
+                cur is not None
+                and cur.matches(ident, members)
+                and os.path.exists(out_paths[b])
+            )
+            if not ok:
+                size, mtime_ns = ReductionCursor.stat_raw(members)
+                cur = ReductionCursor(
+                    members, nfft, ntap, nint, stokes, 0, window=window,
+                    raw_size=size, raw_mtime_ns=mtime_ns, fqav_by=fqav_by,
+                    despike_nfpc=despike_nfpc,
+                )
+            cursors[b] = cur
+            local_done.append(cur.frames_done if ok else 0)
+        # Pod-wide agreement: the window loop is collective-synchronized,
+        # so every process must restart at the SAME offset.  Processes
+        # owning no band rows ride a sentinel above any real count.
+        local_min = min(local_done) if local_done else 1 << 61
+        agreed = int(_gather_int64(
+            np.asarray([local_min], np.int64)
+        ).min())
+        f0_start = min((agreed // wf) * wf, total)
+
     writers = {}
     try:
         for b in mine:
-            writers[b] = _slab_writer(
-                out_paths[b], headers[b], nif, nchans, compression
-            )
+            if resume:
+                from blit.pipeline import ResumableFilWriter
+
+                writers[b] = ResumableFilWriter(
+                    out_paths[b], headers[b], nif, nchans,
+                    f0_start // nint, nint, cursors[b],
+                )
+            else:
+                writers[b] = _slab_writer(
+                    out_paths[b], headers[b], nif, nchans, compression
+                )
 
         def flush(out):
             # Blocking readback of one window's stitched bands -> disk.
@@ -528,7 +598,7 @@ def reduce_scan_mesh_to_files(
         # dispatch happen BEFORE blocking on window N's readback, so host
         # I/O overlaps device compute at one extra window of HBM.
         pending = None
-        f0 = 0
+        f0 = f0_start
         while f0 < total:
             n = min(wf, total - f0)
             ntime = (n + ntap - 1) * nfft

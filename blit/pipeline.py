@@ -495,49 +495,100 @@ class RawReducer:
         """
         if out_path.endswith((".h5", ".hdf5")):
             raise ValueError("reduce_resumable writes .fil (appendable) products")
-        from blit.io.sigproc import read_fil_header, write_fil
-
         raw, hdr = self._open_validated(raw_src)
         # Cursor identity: the member path list (single files keep the plain
         # string so pre-existing sidecars stay valid).
         paths = getattr(raw, "paths", None) or raw.path
         nif = STOKES_NIF[self.stokes]
-        spectrum_bytes = nif * hdr["nchans"] * 4  # float32 products
 
         cur = ReductionCursor.load(out_path)
-        if cur is not None and cur.matches(self, paths) and os.path.exists(out_path):
-            _, data_off = read_fil_header(out_path)
-            good = data_off + (cur.frames_done // self.nint) * spectrum_bytes
-            with open(out_path, "r+b") as f:
-                f.truncate(good)  # drop any un-checkpointed partial slab
+        resuming = (
+            cur is not None
+            and cur.matches(self, paths)
+            and os.path.exists(out_path)
+        )
+        if resuming:
             log.info("resuming %s at frame %d", out_path, cur.frames_done)
         else:
-            write_fil(
-                out_path, hdr, np.zeros((0, nif, hdr["nchans"]), np.float32)
-            )
             size, mtime_ns = ReductionCursor.stat_raw(paths)
             cur = ReductionCursor(
                 paths, self.nfft, self.ntap, self.nint, self.stokes, 0,
                 window=self.window, raw_size=size, raw_mtime_ns=mtime_ns,
                 fqav_by=self.fqav_by, dtype=self.dtype,
             )
-            cur.save(out_path)
-
-        nsamps = cur.frames_done // self.nint
-        with open(out_path, "ab") as f:
-            for slab in self.stream(raw, skip_frames=cur.frames_done):
-                np.ascontiguousarray(slab).tofile(f)
-                # Data must be durable BEFORE the cursor claims it, or a
-                # power loss could leave a cursor ahead of the bytes and the
-                # resume would zero-fill the gap.
-                f.flush()
-                os.fsync(f.fileno())
-                cur.frames_done += slab.shape[0] * self.nint
-                nsamps += slab.shape[0]
-                cur.save(out_path)
-        os.unlink(ReductionCursor.path_for(out_path))
-        hdr["nsamps"] = nsamps
+        start_rows = cur.frames_done // self.nint if resuming else 0
+        w = ResumableFilWriter(
+            out_path, hdr, nif, hdr["nchans"], start_rows, self.nint, cur
+        )
+        try:
+            for slab in self.stream(raw, skip_frames=start_rows * self.nint):
+                w.append(slab)
+            w.close()
+        except BaseException:
+            w.abort()  # file + cursor stay: the resume point
+            raise
+        hdr["nsamps"] = w.nsamps
         return hdr
+
+
+class ResumableFilWriter:
+    """Append-directly ``.fil`` writer whose incompleteness marker is a
+    :class:`ReductionCursor` sidecar instead of a ``.partial`` rename:
+    slabs are fsync'd BEFORE the cursor claims them, so a crash leaves a
+    resumable prefix, never a cursor ahead of the bytes.  Backs BOTH
+    resumable streaming paths — :meth:`RawReducer.reduce_resumable` and
+    the mesh scan writer (blit/parallel/scan.py) — so the durability
+    protocol lives in one place (the FilWriter rule, blit/io/sigproc.py).
+
+    ``start_rows`` > 0 resumes: the product is truncated to that many
+    spectra (dropping any un-checkpointed tail) and the cursor clamped
+    to match; 0 (or a missing file) starts fresh.
+    """
+
+    def __init__(self, path: str, header: Dict, nif: int, nchans: int,
+                 start_rows: int, nint: int, cursor: "ReductionCursor"):
+        from blit.io.sigproc import read_fil_header, write_fil
+
+        self.path = path
+        self._nint = nint
+        self.cursor = cursor
+        if start_rows > 0 and os.path.exists(path):
+            # The cursor may record more frames than the agreed restart
+            # point (the mesh writer restarts at a pod-wide minimum): clamp
+            # it DOWN with the truncation, or a crash before the first new
+            # append would leave it claiming bytes the truncate dropped.
+            _, off = read_fil_header(path)
+            with open(path, "r+b") as f:
+                f.truncate(off + start_rows * nif * nchans * 4)
+            cursor.frames_done = start_rows * nint
+            cursor.save(path)
+        else:
+            start_rows = 0
+            write_fil(path, header, np.zeros((0, nif, nchans), np.float32))
+            cursor.frames_done = 0
+            cursor.save(path)
+        self._f = open(path, "ab")
+        self.nsamps = start_rows
+
+    def append(self, slab: np.ndarray) -> None:
+        np.ascontiguousarray(slab).tofile(self._f)
+        # Durable data BEFORE the cursor claims it (power-loss ordering).
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self.nsamps += slab.shape[0]
+        self.cursor.frames_done = self.nsamps * self._nint
+        self.cursor.save(self.path)
+
+    def close(self) -> None:
+        """Finish: the sidecar's absence is the completeness marker."""
+        self._f.close()
+        sidecar = ReductionCursor.path_for(self.path)
+        if os.path.exists(sidecar):
+            os.unlink(sidecar)
+
+    def abort(self) -> None:
+        # The file + cursor ARE the resume point: keep both.
+        self._f.close()
 
 
 # rawspec-equivalent product presets (SURVEY.md §0: products 0000/0001/0002).
@@ -585,6 +636,11 @@ class ReductionCursor:
     raw_mtime_ns: Union[int, List[int]] = -1
     fqav_by: int = 1
     dtype: str = "float32"
+    # DC-despike width of the product (mesh scan writer; -1 = the path has
+    # no despike, RawReducer's case).  Output-affecting, so it must be part
+    # of resume identity: splicing despiked and non-despiked spectra into
+    # one product would corrupt it silently.
+    despike_nfpc: int = -1
 
     @staticmethod
     def stat_raw(raw_path: Union[str, Sequence[str]]) -> Tuple:
@@ -638,6 +694,7 @@ class ReductionCursor:
             and self.window == red.window
             and self.fqav_by == red.fqav_by
             and self.dtype == red.dtype
+            and self.despike_nfpc == getattr(red, "despike_nfpc", -1)
             and norm(self.raw_size) == norm(size)
             and norm(self.raw_mtime_ns) == norm(mtime_ns)
         )

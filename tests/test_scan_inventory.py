@@ -281,3 +281,125 @@ class TestWindowEquivalenceFuzz:
             np.asarray(data), np.asarray(out)[0], rtol=1e-4, atol=0.5,
             err_msg=f"nint={nint} fqav={fqav} window_frames={wf}",
         )
+
+
+class TestMeshResume:
+    def run_resumable(self, invs, outdir, **kw):
+        return reduce_scan_mesh_to_files(
+            SESSION, SCAN, inventories=invs, out_dir=str(outdir),
+            nfft=NFFT, nint=NINT, window_frames=4, resume=True, **kw,
+        )
+
+    def test_interrupted_run_resumes_to_identical_product(
+        self, tree, tmp_path, monkeypatch
+    ):
+        from blit.parallel import mesh as M
+
+        _, invs = tree
+        golden_dir = tmp_path / "golden"
+        golden_dir.mkdir()
+        self.run_resumable(invs, golden_dir)
+        _, golden = read_fil_data(str(golden_dir / "band0.fil"))
+
+        # Crash mid-stream on the third device window.
+        crash_dir = tmp_path / "crash"
+        crash_dir.mkdir()
+        real = M.band_reduce
+        calls = []
+
+        def flaky(*a, **kw):
+            calls.append(1)
+            if len(calls) == 3:
+                raise RuntimeError("synthetic crash")
+            return real(*a, **kw)
+
+        monkeypatch.setattr(M, "band_reduce", flaky)
+        with pytest.raises(RuntimeError, match="synthetic crash"):
+            self.run_resumable(invs, crash_dir)
+        # The partial product + cursor sidecar survive the crash.
+        out = crash_dir / "band0.fil"
+        assert out.exists() and (crash_dir / "band0.fil.cursor").exists()
+        _, partial = read_fil_data(str(out), mmap=False)
+        assert 0 < partial.shape[0] < golden.shape[0]
+
+        # Resume: continues from the checkpoint, finishes, removes the
+        # cursor, and the product is IDENTICAL to the uninterrupted run.
+        monkeypatch.setattr(M, "band_reduce", real)
+        written = self.run_resumable(invs, crash_dir)
+        assert not (crash_dir / "band0.fil.cursor").exists()
+        _, data = read_fil_data(str(out))
+        np.testing.assert_array_equal(np.asarray(data), np.asarray(golden))
+        assert written[0][1]["nsamps"] == golden.shape[0]
+
+    def test_config_change_restarts_from_scratch(self, tree, tmp_path,
+                                                 monkeypatch):
+        from blit.parallel import mesh as M
+
+        _, invs = tree
+        real = M.band_reduce
+        calls = []
+
+        def flaky(*a, **kw):
+            calls.append(1)
+            if len(calls) == 2:
+                raise RuntimeError("boom")
+            return real(*a, **kw)
+
+        monkeypatch.setattr(M, "band_reduce", flaky)
+        with pytest.raises(RuntimeError):
+            self.run_resumable(invs, tmp_path)
+        monkeypatch.setattr(M, "band_reduce", real)
+        # Different fqav_by: the cursor must NOT match — the run restarts
+        # cleanly instead of splicing incompatible spectra.
+        written = self.run_resumable(invs, tmp_path, fqav_by=2,
+                                     despike=False)
+        _, data = read_fil_data(written[0][0])
+        want = host_golden(invs, fqav_by=2)[: data.shape[0]]
+        np.testing.assert_allclose(np.asarray(data), want, rtol=1e-4,
+                                   atol=1.0)
+
+    def test_resume_rejects_h5(self, tree, tmp_path):
+        _, invs = tree
+        with pytest.raises(ValueError, match="appendable"):
+            self.run_resumable(invs, tmp_path, compression="bitshuffle")
+
+    def test_completed_resumable_equals_plain(self, tree, tmp_path):
+        _, invs = tree
+        plain = tmp_path / "plain"
+        res = tmp_path / "res"
+        plain.mkdir(), res.mkdir()
+        reduce_scan_mesh_to_files(
+            SESSION, SCAN, inventories=invs, out_dir=str(plain),
+            nfft=NFFT, nint=NINT, window_frames=4,
+        )
+        self.run_resumable(invs, res)
+        _, a = read_fil_data(str(plain / "band0.fil"))
+        _, b = read_fil_data(str(res / "band0.fil"))
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_despike_flip_restarts_from_scratch(self, tree, tmp_path,
+                                                monkeypatch):
+        # despike is output-affecting: a resume with the flag flipped must
+        # NOT splice despiked and raw spectra (cursor identity includes
+        # despike_nfpc).
+        from blit.parallel import mesh as M
+
+        _, invs = tree
+        real = M.band_reduce
+        calls = []
+
+        def flaky(*a, **kw):
+            calls.append(1)
+            if len(calls) == 2:
+                raise RuntimeError("boom")
+            return real(*a, **kw)
+
+        monkeypatch.setattr(M, "band_reduce", flaky)
+        with pytest.raises(RuntimeError):
+            self.run_resumable(invs, tmp_path)  # despike=True default
+        monkeypatch.setattr(M, "band_reduce", real)
+        self.run_resumable(invs, tmp_path, despike=False)
+        _, data = read_fil_data(str(tmp_path / "band0.fil"))
+        want = host_golden(invs)[: data.shape[0]]  # un-despiked golden
+        np.testing.assert_allclose(np.asarray(data), want, rtol=1e-4,
+                                   atol=0.5)
