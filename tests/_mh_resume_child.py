@@ -1,0 +1,110 @@
+"""Child process for the 2-process RESUMABLE mesh-writer pod test
+(tests/test_multiprocess.py): the pod-wide restart-offset agreement of
+``reduce_scan_mesh_to_files(resume=True)`` executed for real under
+``jax.distributed``.
+
+Run as: ``python tests/_mh_resume_child.py <pid> <nproc> <port> <outdir>``.
+
+Phases (both processes execute the SAME deterministic sequence, so the
+injected crash is symmetric — mid-collective asymmetric failure is the
+runtime's domain, not this test's):
+
+1. clean run → golden per-band products;
+2. run with band_reduce crashing on its 3rd call → both processes leave
+   per-band cursor sidecars;
+3. resume → must complete, drop the sidecars, and byte-match the golden.
+"""
+
+import os
+import sys
+
+
+def main() -> None:
+    pid, nproc, port, outdir = (
+        int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4]
+    )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from blit.parallel.multihost import init_multihost, local_players
+
+    active = init_multihost(
+        coordinator_address=f"localhost:{port}",
+        num_processes=nproc,
+        process_id=pid,
+        cpu_collectives="gloo",
+    )
+    assert active and jax.process_count() == nproc
+
+    from blit.parallel import mesh as M
+    from blit.parallel.scan import reduce_scan_mesh_to_files
+    from blit.testing import synth_raw
+
+    NBAND, NBANK, NFFT, NINT, NCHAN = 2, 4, 32, 2, 2
+    mesh = M.make_mesh(NBAND, NBANK)
+    local = sorted(local_players(mesh))
+
+    priv = os.path.join(outdir, f"proc{pid}")
+    os.makedirs(priv, exist_ok=True)
+    bank_bw = -187.5 / NBANK
+    paths = [
+        [os.path.join(priv, f"blc{b}{k}.raw") for k in range(NBANK)]
+        for b in range(NBAND)
+    ]
+    for b, k in local:
+        synth_raw(
+            paths[b][k], nblocks=2, obsnchan=NCHAN, ntime_per_block=512,
+            seed=b * 8 + k, tone_chan=k % NCHAN, obsbw=bank_bw,
+            obsfreq=8000.0 + b * 500.0 + (k + 0.5) * bank_bw,
+        )
+
+    def run(tag, resume):
+        d = os.path.join(priv, tag)
+        os.makedirs(d, exist_ok=True)
+        return d, reduce_scan_mesh_to_files(
+            paths, out_dir=d, nfft=NFFT, nint=NINT, despike=False,
+            window_frames=4, resume=resume, mesh=mesh,
+        )
+
+    # 1. Clean golden.
+    gdir, gwritten = run("golden", resume=False)
+
+    # 2. Symmetric crash on the 3rd window (same call count on every
+    #    process — the loop is lockstep).
+    real = M.band_reduce
+    calls = []
+
+    def flaky(*a, **kw):
+        calls.append(1)
+        if len(calls) == 3:
+            raise RuntimeError("synthetic pod crash")
+        return real(*a, **kw)
+
+    M.band_reduce = flaky
+    crashed = False
+    try:
+        run("res", resume=True)
+    except RuntimeError:
+        crashed = True
+    M.band_reduce = real
+    assert crashed and len(calls) == 3, (
+        "the injected 3rd-window crash did not fire (calls=%d) — the test "
+        "would otherwise degrade to resume-from-zero" % len(calls)
+    )
+    rdir = os.path.join(priv, "res")
+    cursors = [p for p in os.listdir(rdir) if p.endswith(".cursor")]
+    assert cursors, "no cursor sidecars after the crash"
+
+    # 3. Resume: completes, cleans up, matches golden byte-for-byte.
+    _, written = run("res", resume=True)
+    assert not any(p.endswith(".cursor") for p in os.listdir(rdir))
+    for band, (path, hdr) in written.items():
+        assert open(path, "rb").read() == open(gwritten[band][0], "rb").read(), (
+            f"resumed band {band} != golden"
+        )
+    print("CHILD-RESUME-OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

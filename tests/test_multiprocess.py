@@ -26,6 +26,7 @@ from blit.testing import synth_raw  # noqa: E402
 NBAND, NBANK, NFFT, NINT, NCHAN = 2, 4, 32, 2, 2
 CHILD = os.path.join(os.path.dirname(__file__), "_mh_child.py")
 PSUM_CHILD = os.path.join(os.path.dirname(__file__), "_mh_psum_child.py")
+RESUME_CHILD = os.path.join(os.path.dirname(__file__), "_mh_resume_child.py")
 
 
 def _free_port() -> int:
@@ -149,4 +150,15 @@ def test_two_process_psum_products_match_golden(tmp_path):
     for rc, out, err in outs:
         assert rc == 0 and "CHILD-PSUM-OK" in out, (
             f"psum pod child failed (rc={rc}):\n{err[-3000:]}"
+        )
+
+
+def test_two_process_resumable_mesh_writer(tmp_path):
+    # The resume restart offset is agreed POD-WIDE (window-aligned MIN over
+    # every process's cursors) — this runs crash → cursors → resume →
+    # byte-identical product under real jax.distributed with 2 processes.
+    outs = _run_pod(str(tmp_path), child=RESUME_CHILD)
+    for rc, out, err in outs:
+        assert rc == 0 and "CHILD-RESUME-OK" in out, (
+            f"resume pod child failed (rc={rc}):\n{err[-3000:]}"
         )
