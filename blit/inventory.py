@@ -137,6 +137,15 @@ def raw_sequences(
     (blit/io/guppi.GuppiScan).  Returns ``(first_record, sorted_paths)``
     per sequence, stem-sorted; records whose ``file`` is not a ``.NNNN.raw``
     member are ignored.
+
+    Duplicate members (two workers inventorying the same file on a shared
+    filesystem) are deduped by exact path — file identity IS the path
+    string here, matching the reference's one-root-path-per-site
+    convention (src/gbt.jl:48).  Two spellings of one file (differing
+    mount prefixes) are NOT conflated: in a pod the same string can name
+    DIFFERENT files on different hosts, so normalizing (realpath) from
+    the main process would be wrong more often than right; such aliases
+    surface as scan_grid's explicit "multiple RAW sequences" error.
     """
     from blit.io.guppi import SEQ_RE
 
@@ -145,10 +154,15 @@ def raw_sequences(
         m = SEQ_RE.match(r.file)
         if m is None:
             continue
-        groups.setdefault(m.group("stem"), []).append((int(m.group("seq")), r))
+        # Dedupe by (stem, seq): on a shared filesystem two workers can
+        # both inventory the same member, and a duplicated path must not
+        # double the "sequence" (GuppiScan would read the recording
+        # twice as if it were longer).  First reporter wins.
+        members = groups.setdefault(m.group("stem"), {})
+        members.setdefault(int(m.group("seq")), r)
     out = []
     for stem in sorted(groups):
-        members = sorted(groups[stem], key=lambda t: t[0])
+        members = sorted(groups[stem].items())
         out.append((members[0][1], [r.file for _, r in members]))
     return out
 

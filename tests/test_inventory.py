@@ -78,3 +78,23 @@ def test_to_dataframe(tmp_path):
     # the reference README's canonical groupby workflow (README.md:95-157)
     g = df.groupby(["session", "scan"]).size()
     assert g.loc[("AGBT22B_999_01", "0011")] == 2
+
+
+class TestRawSequenceDedup:
+    def test_duplicate_members_deduped(self):
+        # Shared filesystem: two workers inventory the SAME member file.
+        # The sequence must not double (GuppiScan would read the
+        # recording twice as if it were longer); first reporter wins.
+        from blit.inventory import raw_sequences
+
+        mk = lambda host, f, w: InventoryRecord(
+            1, 2, "S", "0001", "src", 0, 0, host, f, w)
+        out = raw_sequences([
+            mk("h1", "/d/x.0000.raw", 1),
+            mk("h2", "/d/x.0000.raw", 2),
+            mk("h1", "/d/x.0001.raw", 1),
+        ])
+        assert len(out) == 1
+        rec, paths = out[0]
+        assert paths == ["/d/x.0000.raw", "/d/x.0001.raw"]
+        assert rec.worker == 1  # first reporter
