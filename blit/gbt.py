@@ -180,10 +180,12 @@ def load_scan(
     banks are stitched from what exists (ragged results are first-class) with
     a warning.
     """
+    from blit.inventory import _is_worker_error
+
     recs = [
         r
         for inv in inventories
-        if not isinstance(inv, WorkerError)
+        if not _is_worker_error(inv)
         for r in inv
         if r.session == session and r.scan == scan and r.file.endswith(suffix)
     ]
@@ -192,7 +194,23 @@ def load_scan(
     out: Dict[int, Tuple[Dict, np.ndarray]] = {}
     bands = sorted({r.band for r in recs})
     for band in bands:
-        bankrecs = sorted((r for r in recs if r.band == band), key=lambda r: r.bank)
+        # One record per bank: duplicates (two workers inventorying the
+        # same file on a shared filesystem, or two files claiming one
+        # player) must not stitch the bank twice into a double-width
+        # band.  First record per bank wins, like raw_sequences' dedup.
+        by_bank: Dict[int, InventoryRecord] = {}
+        for r in sorted((r for r in recs if r.band == band),
+                        key=lambda r: r.bank):
+            if r.bank in by_bank:
+                if r.file != by_bank[r.bank].file:
+                    log.warning(
+                        "band %d bank %d: multiple files (%s kept, %s "
+                        "dropped)", band, r.bank, by_bank[r.bank].file,
+                        r.file,
+                    )
+                continue
+            by_bank[r.bank] = r
+        bankrecs = list(by_bank.values())
         if len(bankrecs) < 8:
             log.warning(
                 "band %d: only banks %s present for %s/%s",

@@ -190,12 +190,10 @@ def scan_grid(
     Returns ``(band_ids, bank_ids, grid)`` where ``grid[i][j]`` is the
     sorted path list of band ``band_ids[i]``, bank ``bank_ids[j]``.
     """
-    from blit.parallel.pool import WorkerError  # lazy: avoid import cycle
-
     recs = [
         r
         for inv in inventories
-        if not isinstance(inv, (WorkerError, Exception))
+        if not _is_worker_error(inv)
         for r in inv
         if r.session == session and r.scan == scan
     ]
@@ -227,10 +225,17 @@ def scan_grid(
 def to_dataframe(inventories: Iterable[Iterable[InventoryRecord]]):
     """Flatten per-worker inventories into one pandas DataFrame — the L4
     analysis-layer workflow from the reference README
-    (``DataFrame(Iterators.flatten(invs))``, README.md:95-157)."""
+    (``DataFrame(Iterators.flatten(invs))``, README.md:95-157).  Captured
+    ``WorkerError`` entries (live or restored by :func:`load_inventories`)
+    are skipped, like every other consumer of the ragged shape."""
     import pandas as pd
 
-    flat = [rec for inv in inventories for rec in inv]
+    flat = [
+        rec
+        for inv in inventories
+        if not _is_worker_error(inv)
+        for rec in inv
+    ]
     return pd.DataFrame(flat, columns=InventoryRecord._fields)
 
 
@@ -239,10 +244,24 @@ def save_inventories(path: str, inventories) -> int:
     "state" is a saved pid vector + inventory DataFrame, README.md:62-64,
     100-101 — this is the durable half).  Each line is one record plus its
     worker-list index, so :func:`load_inventories` restores the ragged
-    per-worker shape exactly.  Returns the record count."""
+    per-worker shape exactly — including ``WorkerError`` entries from a
+    captured fan-out (``get_inventories(on_error="capture")``), which
+    round-trip as error markers rather than crashing the save.  Returns
+    the record count."""
     n = 0
     with open(path, "w") as f:
         for w, inv in enumerate(inventories):
+            if _is_worker_error(inv):
+                # getattr fallbacks: _is_worker_error also admits bare
+                # Exception entries, which lack WorkerError's fields.
+                err = getattr(inv, "error", inv)
+                f.write(json.dumps({
+                    "_w": w,
+                    "_error": f"{type(err).__name__}: {err}",
+                    "_host": getattr(inv, "host", ""),
+                    "_worker": getattr(inv, "worker", w),
+                }) + "\n")
+                continue
             wrote_any = False
             for rec in inv:
                 row = rec._asdict()
@@ -255,15 +274,35 @@ def save_inventories(path: str, inventories) -> int:
     return n
 
 
-def load_inventories(path: str) -> List[List[InventoryRecord]]:
-    """Restore what :func:`save_inventories` wrote (ragged shape included)."""
-    out: List[List[InventoryRecord]] = []
+def _is_worker_error(entry) -> bool:
+    """True for a captured per-worker failure entry (lazy import: the pool
+    is jax-free but keeping inventory importable standalone matters)."""
+    from blit.parallel.pool import WorkerError
+
+    return isinstance(entry, (WorkerError, Exception))
+
+
+def load_inventories(path: str) -> List:
+    """Restore what :func:`save_inventories` wrote (ragged shape included).
+    Captured failures come back as ``WorkerError`` entries carrying the
+    saved message, so downstream consumers (``scan_grid``, ``load_scan``)
+    skip them exactly as they would the live objects."""
+    from blit.parallel.pool import WorkerError
+
+    out: List = []
     with open(path) as f:
         for line in f:
             row = json.loads(line)
             w = row.pop("_w")
             while len(out) <= w:
                 out.append([])
+            if "_error" in row:
+                out[w] = WorkerError(
+                    worker=row.get("_worker", w),
+                    host=row.get("_host", ""),
+                    error=RuntimeError(row["_error"]),
+                )
+                continue
             if row.pop("_empty", False):
                 continue
             out[w].append(InventoryRecord(**row))

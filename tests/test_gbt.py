@@ -121,3 +121,69 @@ def test_load_scan_missing_banks_ok(tree, caplog):
 def test_load_scan_empty():
     pool = WorkerPool(["h"], backend="local")
     assert gbt.load_scan([[]], "NOPE", "0000", pool=pool) == {}
+
+
+def test_load_scan_dedupes_duplicate_bank_records(tree):
+    # Shared filesystem: two workers report the same bank file.  The band
+    # must stitch each bank ONCE (not double-width).
+    root, _ = tree
+    pool = WorkerPool(["h1", "h2"], backend="local")
+    invs = gbt.get_inventories(pool=pool, root=root)
+    # Both workers saw the whole tree: every file appears twice across
+    # the per-worker inventories.
+    out = gbt.load_scan(invs, "AGBT22B_999_01", "0011", pool=pool)
+    hdr, data = out[0]
+    assert data.shape == (16, 1, 256)  # 4 banks x 64, not 8 x 64
+    assert hdr["nchans"] == 256
+    pool.shutdown()
+
+
+def test_save_load_inventories_roundtrip_worker_errors(tree, tmp_path):
+    from blit.inventory import load_inventories, save_inventories
+
+    root, _ = tree
+    pool = WorkerPool(["h"], backend="local")
+    invs = gbt.get_inventories(pool=pool, root=root)
+    dead = WorkerError(worker=2, host="blc77",
+                       error=RuntimeError("ssh: no route to host"))
+    p = str(tmp_path / "inv.jsonl")
+    n = save_inventories(p, [invs[0], dead, []])
+    assert n == len(invs[0])
+    restored = load_inventories(p)
+    assert restored[0] == invs[0]
+    assert isinstance(restored[1], WorkerError)
+    assert restored[1].host == "blc77" and restored[1].worker == 2
+    assert "no route to host" in str(restored[1].error)
+    assert restored[2] == []
+    # The restored shape feeds consumers exactly like live output: the
+    # error entry is skipped (not crashed on) by the scan resolver.
+    from blit.inventory import scan_grid
+
+    with pytest.raises(ValueError, match="no RAW sequences"):
+        scan_grid(restored, "AGBT22B_999_01", "0011")  # fbh5 tree: no .raw
+    pool.shutdown()
+
+
+def test_error_entries_skipped_everywhere(tree, tmp_path):
+    # Every consumer of the ragged inventories shape must skip error
+    # entries — WorkerError AND bare Exception — identically.
+    from blit.inventory import (
+        load_inventories,
+        save_inventories,
+        to_dataframe,
+    )
+
+    root, _ = tree
+    pool = WorkerPool(["h"], backend="local")
+    invs = gbt.get_inventories(pool=pool, root=root)
+    ragged = [invs[0], WorkerError(2, "blc01", RuntimeError("x")),
+              RuntimeError("bare")]
+    df = to_dataframe(ragged)
+    assert len(df) == len(invs[0])
+    out = gbt.load_scan(ragged, "AGBT22B_999_01", "0011", pool=pool)
+    assert set(out) == {0}
+    p = str(tmp_path / "inv.jsonl")
+    save_inventories(p, ragged)
+    restored = load_inventories(p)
+    assert len(restored) == 3 and len(to_dataframe(restored)) == len(invs[0])
+    pool.shutdown()
