@@ -350,6 +350,17 @@ class GuppiScan(_BlockStream):
 
     def _check_sequence(self, strict: bool) -> None:
         problems = []
+        # A member listed twice would silently splice the same voltages
+        # into the stream twice (a "longer" recording of wrong data) —
+        # catch it on the raw path list, grammar or not.  Paths are
+        # realpath-normalized so alias spellings (./x vs x, symlinks) of
+        # one local file cannot dodge the check; unlike the inventory
+        # layer, this list names files on THIS host, so resolving is safe.
+        real = [os.path.realpath(p) for p in self.paths]
+        if len(set(real)) != len(real):
+            dups = sorted({p for p, r in zip(self.paths, real)
+                           if real.count(r) > 1})
+            problems.append(f"duplicate member paths: {dups}")
         # Stem / NNNN continuity (when the names follow the grammar).
         parsed = [SEQ_RE.match(p) for p in self.paths]
         if all(parsed) and len({m.group("stem") for m in parsed}) == 1:

@@ -224,3 +224,32 @@ class TestSequenceReduction:
         with open(paths[1], "ab") as f:
             f.write(b"\0")
         assert not cur.matches(red, paths)
+
+
+class TestDuplicateMembers:
+    def test_duplicate_member_flagged(self, tmp_path):
+        # The same member listed twice would splice its voltages into the
+        # stream twice; strict mode refuses, default warns.
+        paths, _ = synth_raw_sequence(
+            str(tmp_path / "s"), nfiles=2, blocks_per_file=1, obsnchan=2,
+            ntime_per_block=256,
+        )
+        with pytest.raises(ValueError, match="duplicate"):
+            GuppiScan([paths[0], paths[0], paths[1]], strict=True)
+        # Alias spellings of one file must not dodge the check.
+        import os
+        rel = os.path.join(os.path.dirname(paths[0]), ".",
+                           os.path.basename(paths[0]))
+        with pytest.raises(ValueError, match="duplicate"):
+            GuppiScan([paths[0], rel, paths[1]], strict=True)
+
+    def test_duplicate_warns_by_default(self, tmp_path, caplog):
+        import logging
+
+        paths, _ = synth_raw_sequence(
+            str(tmp_path / "s"), nfiles=2, blocks_per_file=1, obsnchan=2,
+            ntime_per_block=256,
+        )
+        with caplog.at_level(logging.WARNING, logger="blit.guppi"):
+            GuppiScan([paths[0], paths[0], paths[1]])
+        assert any("duplicate" in r.message for r in caplog.records)
