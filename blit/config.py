@@ -62,12 +62,14 @@ class SiteConfig:
     backend: str = "thread"
     # Worker liveness deadlines (remote backend; SURVEY.md §5 "health-checked
     # worker pool"): per-call reply deadline and the agent-reuse ping
-    # deadline.  The call deadline must sit ABOVE any legitimate single
-    # call — a whole-scan reduce_raw can run tens of minutes (bench.py
-    # budgets 1500 s for ONE channelize attempt on the dev rig), and a
-    # deadline that fires on healthy work kills the agent mid-write.
-    # None = block forever (the reference's fetch behavior).
-    call_timeout: Optional[float] = 3600.0
+    # deadline.  The call deadline is OPT-IN (ADVICE r4): no finite default
+    # sits safely above every legitimate single call — a whole-scan
+    # reduce_raw can run hours, and a deadline that fires on healthy work
+    # kills the agent mid-write.  None = block forever (the reference's
+    # fetch behavior); sites that want kill-on-deadline liveness set it
+    # above their largest sanctioned workload.  The reuse-time ping below
+    # still bounds committing NEW work to a wedged agent either way.
+    call_timeout: Optional[float] = None
     ping_timeout: Optional[float] = 30.0
 
     def __post_init__(self):

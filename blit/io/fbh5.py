@@ -23,6 +23,42 @@ import numpy as np
 from blit.config import nfpc_from_foff
 from blit.io.bshuf import BITSHUFFLE_FILTER_ID
 
+# libhdf5 refuses chunks of 4 GiB or more (H5Dcreate fails); hi-res blit
+# products have 2^20-point spectra, where BL's conventional 16-spectra chunk
+# row would be 16 GiB — defaults must clamp, not crash at writer open.
+H5_CHUNK_LIMIT = 2**32 - 1
+
+
+def default_chunks(
+    nifs: int,
+    nchans: int,
+    itemsize: int,
+    *,
+    whole_spectrum: bool = False,
+) -> Tuple[int, int, int]:
+    """BL's conventional ``(16, nifs, nchans)`` whole-spectrum chunk rows,
+    with the time rows clamped so chunk bytes stay under HDF5's 4 GiB-1
+    chunk limit (a hi-res 64-channel-bank Stokes product is 256 MiB per
+    spectrum; the full-band IQUV mesh product is 8 GiB per spectrum).
+
+    When even ONE spectrum exceeds the limit the channel axis is split —
+    unless ``whole_spectrum=True`` (the streaming bitshuffle writer stores
+    one chunk per time row and cannot split channels), which raises
+    instead of returning an unusable chunk shape.
+    """
+    row_bytes = nifs * nchans * itemsize
+    rows = max(1, min(16, H5_CHUNK_LIMIT // max(row_bytes, 1)))
+    if rows * row_bytes <= H5_CHUNK_LIMIT:
+        return (rows, nifs, nchans)
+    if whole_spectrum:
+        raise ValueError(
+            f"one ({nifs}, {nchans}) spectrum is {row_bytes} bytes, over "
+            f"HDF5's 4 GiB-1 chunk limit, and this writer needs "
+            "whole-spectrum chunks: reduce nchans per product (e.g. "
+            "per-band files) or use uncompressed/gzip output"
+        )
+    return (1, nifs, max(1, H5_CHUNK_LIMIT // (nifs * itemsize)))
+
 
 def _bitshuffle_cd_values(ds) -> Optional[Tuple]:
     """cd_values if the dataset's filter pipeline contains bitshuffle."""
@@ -286,8 +322,17 @@ class FBH5Writer:
         elif compression is not None:
             raise ValueError(f"unknown compression {compression!r}")
         # A time-resizable dataset must be chunked; default matches
-        # write_fbh5's BL convention (16-spectra rows, whole channel span).
-        self.chunks = tuple(chunks) if chunks else (16, nifs, nchans)
+        # write_fbh5's BL convention (16-spectra rows, whole channel span),
+        # clamped under the HDF5 chunk-size limit (ADVICE r4: the hi-res
+        # preset's unclamped default chunk was 16 GiB and failed at open).
+        self.chunks = (
+            tuple(chunks)
+            if chunks
+            else default_chunks(
+                nifs, nchans, self.dtype.itemsize,
+                whole_spectrum=self._bitshuffle,
+            )
+        )
         if self._bitshuffle and self.chunks[1:] != (nifs, nchans):
             # The streaming encoder stores one chunk per time row (corner
             # (t, 0, 0)); channel-split chunks would silently drop data.
@@ -424,9 +469,8 @@ def write_fbh5(
                 "bitshuffle codec unavailable; build blit/native first"
             )
         bitshuffle = True
-        kw["chunks"] = chunks or (
-            min(data.shape[0], 16), data.shape[1], data.shape[2]
-        )
+        dc = default_chunks(data.shape[1], data.shape[2], data.dtype.itemsize)
+        kw["chunks"] = chunks or (max(1, min(data.shape[0], dc[0])), dc[1], dc[2])
         kw["compression"] = BITSHUFFLE_FILTER_ID
         kw["compression_opts"] = bshuf.filter_cd_values(data.dtype.itemsize)
         kw["allow_unknown_filter"] = True

@@ -124,6 +124,25 @@ def read_fil_data(
 
 
 
+def validate_slab(slab: np.ndarray, nifs: int, nchans: int,
+                  dtype: np.dtype) -> np.ndarray:
+    """The SIGPROC slab guard, shared by every ``.fil`` append path
+    (FilWriter here and blit.pipeline.ResumableFilWriter): SIGPROC derives
+    nsamps from file size, so a mis-shaped or mis-typed slab would write a
+    valid-looking corrupt product nothing downstream can detect.  Shape
+    must match exactly; dtype is coerced only within the same kind
+    (float64→float32 fine; float→uint8 would silently wrap sample values
+    and is refused)."""
+    if slab.ndim != 3 or slab.shape[1:] != (nifs, nchans):
+        raise ValueError(
+            f"append: slab shape {slab.shape} does not extend "
+            f"(*, {nifs}, {nchans})"
+        )
+    if slab.dtype != dtype:
+        slab = slab.astype(dtype, casting="same_kind")
+    return np.ascontiguousarray(slab)
+
+
 class FilWriter:
     """Streaming ``.fil`` slab writer with ``.partial`` atomicity — the
     SIGPROC twin of :class:`blit.io.fbh5.FBH5Writer`'s append interface.
@@ -141,13 +160,18 @@ class FilWriter:
         self.final_path = path
         self.path = path + ".partial"
         self._os = _os
+        self.nifs = nifs
+        self.nchans = nchans
+        self.dtype = np.dtype(dtype)
         write_fil(self.path, header, np.zeros((0, nifs, nchans), dtype))
         self._f = open(self.path, "ab")
         self.nsamps = 0
 
     def append(self, slab: np.ndarray) -> None:
-        """Append ``(k, nifs, nchans)`` spectra."""
-        np.ascontiguousarray(slab).tofile(self._f)
+        """Append ``(k, nifs, nchans)`` spectra (validated + same-kind
+        dtype-coerced by :func:`validate_slab`)."""
+        slab = validate_slab(slab, self.nifs, self.nchans, self.dtype)
+        slab.tofile(self._f)
         self.nsamps += slab.shape[0]
 
     def close(self) -> None:

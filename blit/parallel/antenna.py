@@ -217,26 +217,30 @@ def load_correlator_mesh(
     cper = nchan // nbank
 
     # Read each (antenna, band-row) time window ONCE, slice per bank.
+    # Devices are grouped by band row so a row's decoded blocks are freed
+    # as soon as that row's local devices are fed (device_put has copied
+    # them) — host residency is ONE band row of all antennas, not every
+    # owned row at once (ADVICE r4: the flat cache held nant * nchan * seg
+    # * npol * 8 bytes per owned row simultaneously).
     shards_r, shards_i = [], []
-    devices, indices = [], []
     dev_map = sharding.addressable_devices_indices_map(
         (nant, nchan, ntime, npol)
     )
-    blocks: Dict[Tuple[int, int], Tuple[np.ndarray, np.ndarray]] = {}
+    by_band: Dict[int, list] = {}
     for d, idx in dev_map.items():
         b = (idx[2].start or 0) // seg  # band row from the time slice
-        for a in range(nant):
-            if (a, b) not in blocks:
-                blocks[(a, b)] = _planar_block(raws[a], b * seg, seg)
-        k = (idx[1].start or 0) // cper
-        br = np.stack([blocks[(a, b)][0][k * cper:(k + 1) * cper]
-                       for a in range(nant)])
-        bi = np.stack([blocks[(a, b)][1][k * cper:(k + 1) * cper]
-                       for a in range(nant)])
-        shards_r.append(jax.device_put(br, d))
-        shards_i.append(jax.device_put(bi, d))
-        devices.append(d)
-        indices.append(idx)
+        by_band.setdefault(b, []).append((d, idx))
+    for b in sorted(by_band):
+        blocks = [_planar_block(raws[a], b * seg, seg) for a in range(nant)]
+        for d, idx in by_band[b]:
+            k = (idx[1].start or 0) // cper
+            br = np.stack([blocks[a][0][k * cper:(k + 1) * cper]
+                           for a in range(nant)])
+            bi = np.stack([blocks[a][1][k * cper:(k + 1) * cper]
+                           for a in range(nant)])
+            shards_r.append(jax.device_put(br, d))
+            shards_i.append(jax.device_put(bi, d))
+        del blocks
     global_shape = (nant, nchan, ntime, npol)
     vr = jax.make_array_from_single_device_arrays(
         global_shape, sharding, shards_r

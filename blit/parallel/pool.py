@@ -24,6 +24,7 @@ Differences from the reference, by design (SURVEY.md §5 "Failure detection"):
 from __future__ import annotations
 
 import logging
+import time
 from concurrent.futures import Future, ProcessPoolExecutor, ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Any, Callable, List, Optional, Sequence
@@ -35,6 +36,12 @@ log = logging.getLogger("blit.pool")
 # Distinguishes "not given" (inherit SiteConfig) from an explicit None
 # (disable the deadline — the reference's blocking behavior).
 _UNSET = object()
+
+
+def _remaining(deadline: Optional[float]) -> Optional[float]:
+    """Seconds left until a shared ``time.monotonic()`` deadline (0 once
+    past — ``Future.result`` treats 0 as an immediate-expiry poll)."""
+    return None if deadline is None else max(0.0, deadline - time.monotonic())
 
 
 @dataclass
@@ -157,12 +164,15 @@ class WorkerPool:
         ``@spawnat worker fn(args...)`` + ``fetch.`` fan-out/fan-in
         (src/gbt.jl:54-57, 75-78).  Results are ordered like ``wids``.
 
-        ``timeout`` bounds each fan-in wait (seconds); a late worker
-        raises ``TimeoutError`` (or becomes a ``WorkerError`` under
-        ``on_error="capture"``).  The remote backend's own call deadline
-        also KILLS the wedged agent (blit/parallel/remote.py); for the
-        thread/process backends the abandoned call keeps running to
-        completion in the background — Python offers no safe cancel."""
+        ``timeout`` bounds the WHOLE fan-in (seconds, one shared deadline
+        across the ordered waits — the calls run concurrently, so waiting
+        per-future would let worst-case wall clock grow to
+        ``len(wids) * timeout``); a late worker raises ``TimeoutError``
+        (or becomes a ``WorkerError`` under ``on_error="capture"``).  The
+        remote backend's own call deadline also KILLS the wedged agent
+        (blit/parallel/remote.py); for the thread/process backends the
+        abandoned call keeps running to completion in the background —
+        Python offers no safe cancel."""
         if len(wids) != len(argtuples):
             raise ValueError("wids and argtuples must have the same length")
         bad = [w for w in wids if not 1 <= w <= len(self.workers)]
@@ -176,10 +186,11 @@ class WorkerPool:
             self._submit(self.workers[wid - 1], fn, *args, **kwargs)
             for wid, args in zip(wids, argtuples)
         ]
+        deadline = None if timeout is None else time.monotonic() + timeout
         results: List[Any] = []
         for wid, fut in zip(wids, futures):
             try:
-                results.append(fut.result(timeout=timeout))
+                results.append(fut.result(timeout=_remaining(deadline)))
             except Exception as e:  # noqa: BLE001
                 if on_error == "capture":
                     log.warning("worker %d (%s) failed: %s", wid, self.host_of(wid), e)
@@ -196,16 +207,17 @@ class WorkerPool:
         timeout: Optional[float] = None,
     ) -> List[Any]:
         """Call ``fn`` once on every worker (reference: the getinventories
-        fan-out, src/gbt.jl:54-57).  ``timeout`` bounds each fan-in wait as
-        in :meth:`run_on`."""
+        fan-out, src/gbt.jl:54-57).  ``timeout`` bounds the whole fan-in
+        (one shared deadline) as in :meth:`run_on`."""
         futures = []
         for w in self.workers:
             kw = kwargs_per_worker(w) if kwargs_per_worker else {}
             futures.append(self._submit(w, fn, **kw))
+        deadline = None if timeout is None else time.monotonic() + timeout
         results = []
         for w, fut in zip(self.workers, futures):
             try:
-                results.append(fut.result(timeout=timeout))
+                results.append(fut.result(timeout=_remaining(deadline)))
             except Exception as e:  # noqa: BLE001
                 if on_error == "capture":
                     log.warning("worker %d (%s) failed: %s", w.wid, w.host, e)

@@ -107,14 +107,16 @@ class RemoteWorker:
       the (much shorter) ``ping_timeout``; an agent that cannot answer is
       killed and respawned BEFORE the real request is committed to it.
 
-    ``call_timeout=None`` disables the deadline (the reference's blocking
-    behavior); the default is generous — worker functions legitimately
-    stream multi-GB files.
+    ``call_timeout=None`` (the default — the reference's blocking behavior)
+    disables the deadline: worker functions legitimately stream multi-GB
+    files for hours, so kill-on-deadline is opt-in, sized by the caller
+    above their largest sanctioned workload (ADVICE r4).  The reuse-time
+    ping still applies either way.
     """
 
     def __init__(self, host: str, command: Optional[Sequence[str]] = None,
                  env: Optional[dict] = None,
-                 call_timeout: Optional[float] = 600.0,
+                 call_timeout: Optional[float] = None,
                  ping_timeout: Optional[float] = 30.0,
                  ping_min_idle: float = 5.0):
         self.host = host
@@ -219,6 +221,23 @@ class RemoteWorker:
                     if done.is_set():  # reply landed first; stand down
                         return
                     timed_out.set()
+                if fn_path == "ping":
+                    # Routine self-healing: _ensure logs the respawn at
+                    # WARNING and the remedy knob is ping_timeout, not
+                    # call_timeout — don't raise a spurious ERROR here.
+                    log.debug("%s: ping watchdog fired after %ss",
+                              self.host, timeout)
+                else:
+                    # Prominent by design (ADVICE r4): a deadline sized
+                    # below a legitimate long call would otherwise kill
+                    # healthy work with only an exception in some caller's
+                    # future to show for it.
+                    log.error(
+                        "%s: call watchdog fired after %ss during %s — "
+                        "killing agent (raise call_timeout if this call "
+                        "was healthy)",
+                        self.host, timeout, fn_path,
+                    )
                 try:
                     p.kill()
                 except OSError:

@@ -435,7 +435,8 @@ class RawReducer:
         return hdr, data
 
     def reduce_to_file(self, raw_src: RawSource, out_path: str,
-                       compression: Optional[str] = None) -> Dict:
+                       compression: Optional[str] = None,
+                       chunks: Optional[Tuple[int, int, int]] = None) -> Dict:
         """Reduce and write a ``.fil`` or (``.h5``) FBH5 product.
 
         Both formats STREAM slab-by-slab to disk at bounded host memory
@@ -446,7 +447,8 @@ class RawReducer:
         lands in a ``.partial`` sibling renamed on success.
 
         ``compression`` applies to ``.h5`` output only: None | "gzip" |
-        "bitshuffle" (BL's production codec, via the native encoder).
+        "bitshuffle" (BL's production codec, via the native encoder);
+        ``chunks`` overrides the writer's clamped default HDF5 chunk shape.
         """
         if out_path.endswith((".h5", ".hdf5")):
             from blit.io.fbh5 import FBH5Writer
@@ -455,7 +457,7 @@ class RawReducer:
             nif = STOKES_NIF[self.stokes]
             with FBH5Writer(
                 out_path, hdr, nifs=nif, nchans=hdr["nchans"],
-                compression=compression,
+                compression=compression, chunks=chunks,
             ) as w:
                 for slab in self.stream(raw):
                     w.append(np.ascontiguousarray(slab))
@@ -464,6 +466,8 @@ class RawReducer:
         if compression is not None:
             raise ValueError(".fil products are uncompressed; compression "
                              "applies to .h5 output")
+        if chunks is not None:
+            raise ValueError("chunks applies to .h5 output")
         from blit.io.sigproc import FilWriter
 
         raw, hdr = self._open_validated(raw_src)
@@ -551,6 +555,8 @@ class ResumableFilWriter:
 
         self.path = path
         self._nint = nint
+        self._nif = nif
+        self._nchans = nchans
         self.cursor = cursor
         if start_rows > 0 and os.path.exists(path):
             # The cursor may record more frames than the agreed restart
@@ -571,7 +577,11 @@ class ResumableFilWriter:
         self.nsamps = start_rows
 
     def append(self, slab: np.ndarray) -> None:
-        np.ascontiguousarray(slab).tofile(self._f)
+        from blit.io.sigproc import validate_slab
+
+        slab = validate_slab(slab, self._nif, self._nchans,
+                             np.dtype(np.float32))
+        slab.tofile(self._f)
         # Durable data BEFORE the cursor claims it (power-loss ordering).
         self._f.flush()
         os.fsync(self._f.fileno())

@@ -191,3 +191,40 @@ class TestConstructionGuards:
         with FBH5Writer(p, HDR, nifs=1, nchans=1 << 20) as w:
             assert w._buf is None
             w.append(np.zeros((1, 1, 1 << 20), np.float32))
+
+
+class TestChunkClamp:
+    """HDF5 refuses chunks of 4 GiB or more; defaults must clamp (ADVICE
+    r4: the hi-res preset's unclamped 16-row default was 16 GiB and made
+    the flagship .h5 product unwritable via the public APIs)."""
+
+    def test_default_chunks_clamped_under_limit(self):
+        from blit.io.fbh5 import H5_CHUNK_LIMIT, default_chunks
+
+        # hi-res bank product: 64 coarse channels x 2^20 fine = 256 MiB/row.
+        c = default_chunks(1, 64 << 20, 4)
+        assert c == (15, 1, 64 << 20)
+        assert c[0] * c[1] * c[2] * 4 <= H5_CHUNK_LIMIT
+        # IQUV hi-res: 1 GiB rows -> 3.
+        assert default_chunks(4, 64 << 20, 4)[0] == 3
+        # Small products keep BL's conventional 16 rows.
+        assert default_chunks(4, 64, 4) == (16, 4, 64)
+
+    def test_default_chunks_splits_channels_past_limit(self):
+        from blit.io.fbh5 import H5_CHUNK_LIMIT, default_chunks
+
+        # Full-band IQUV mesh product: one spectrum is 8 GiB.
+        rows, nifs, cchunk = default_chunks(4, 512 << 20, 4)
+        assert rows == 1 and nifs == 4 and cchunk < 512 << 20
+        assert rows * nifs * cchunk * 4 <= H5_CHUNK_LIMIT
+        with pytest.raises(ValueError, match="whole-spectrum"):
+            default_chunks(4, 512 << 20, 4, whole_spectrum=True)
+
+    def test_hires_writer_opens_with_default_chunks(self, tmp_path):
+        # The ADVICE repro: writer open at the hi-res shape must succeed.
+        p = str(tmp_path / "hires.h5")
+        w = FBH5Writer(p, HDR, nifs=1, nchans=64 << 20)
+        try:
+            assert w.chunks[0] * w.chunks[1] * w.chunks[2] * 4 < 2**32
+        finally:
+            w.abort()

@@ -52,6 +52,35 @@ def test_fil_mmap_vs_read(tmp_path):
     assert isinstance(a, np.memmap) and not isinstance(b, np.memmap)
 
 
+def test_fil_writer_validates_slabs(tmp_path):
+    # SIGPROC derives nsamps from file size, so a mis-shaped or mis-typed
+    # slab would write a valid-looking corrupt product nothing downstream
+    # detects (ADVICE r4) — append must validate shape and coerce dtype.
+    from blit.io.sigproc import FilWriter
+
+    hdr = testing.make_fil_header(nchans=16)
+    p = str(tmp_path / "x.fil")
+    with FilWriter(p, hdr, nifs=2, nchans=16) as w:
+        with pytest.raises(ValueError, match="slab shape"):
+            w.append(np.zeros((3, 2, 8), np.float32))  # wrong nchans
+        with pytest.raises(ValueError, match="slab shape"):
+            w.append(np.zeros((3, 16), np.float32))  # wrong ndim
+        w.append(np.arange(3 * 2 * 16, dtype=np.float64).reshape(3, 2, 16))
+    _, data = read_fil_data(p)
+    assert data.dtype == np.float32  # float64 slab coerced, not raw-written
+    np.testing.assert_array_equal(
+        np.asarray(data).ravel(), np.arange(3 * 2 * 16, dtype=np.float32)
+    )
+    # Cross-kind coercion would silently wrap sample values (300.0 -> 44):
+    # refused, same-kind only.
+    hdr8 = testing.make_fil_header(nchans=16)
+    with FilWriter(str(tmp_path / "u8.fil"), hdr8, nifs=1, nchans=16,
+                   dtype=np.uint8) as w:
+        with pytest.raises(TypeError):
+            w.append(np.full((1, 1, 16), 300.0, np.float32))
+        w.append(np.zeros((1, 1, 16), np.uint8))
+
+
 def test_fil_uint8_dtype(tmp_path):
     p = str(tmp_path / "u8.fil")
     hdr = testing.make_fil_header(nchans=8)

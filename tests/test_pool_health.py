@@ -185,7 +185,9 @@ class TestConfigPlumbing:
 
         pool = WorkerPool(["a"], backend="local")
         try:
-            assert pool.call_timeout == DEFAULT.call_timeout == 3600.0
+            # Opt-in deadline (ADVICE r4): no finite default sits above
+            # every legitimate call, so the default is block-forever.
+            assert pool.call_timeout is None and DEFAULT.call_timeout is None
             assert pool.ping_timeout == DEFAULT.ping_timeout == 30.0
         finally:
             pool.shutdown()
