@@ -34,9 +34,11 @@ def _cmd_reduce(args: argparse.Namespace) -> int:
         red = RawReducer(nfft=args.nfft, nint=args.nint, **kw)
     src: object = args.raw[0] if len(args.raw) == 1 else args.raw
     if args.resume:
-        hdr = red.reduce_resumable(src, args.output)
+        hdr = red.reduce_resumable(src, args.output,
+                                   compression=args.compression)
     else:
-        hdr = red.reduce_to_file(src, args.output)
+        hdr = red.reduce_to_file(src, args.output,
+                                 compression=args.compression)
     stats = red.stats
     print(
         json.dumps(
@@ -158,8 +160,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="on-device frequency averaging factor")
     pr.add_argument("--dtype", default="float32",
                     choices=["float32", "bfloat16"])
+    pr.add_argument("--compression", default=None,
+                    choices=["gzip", "bitshuffle"],
+                    help="codec for .h5 (FBH5) output")
     pr.add_argument("--resume", action="store_true",
-                    help="crash-resumable streaming (.fil only)")
+                    help="crash-resumable streaming (cursor sidecar; "
+                         ".fil and .h5)")
     pr.set_defaults(fn=_cmd_reduce)
 
     ps = sub.add_parser(
@@ -184,8 +190,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                     choices=["gzip", "bitshuffle"],
                     help="write .h5 (FBH5) band products with this codec")
     ps.add_argument("--resume", action="store_true",
-                    help="crash-resumable streaming (.fil only; cursor "
-                         "sidecar per band)")
+                    help="crash-resumable streaming (cursor sidecar per "
+                         "band; .fil and .h5, incl. --compression "
+                         "bitshuffle)")
     ps.set_defaults(fn=_cmd_scan)
 
     pi = sub.add_parser("inventory", help="crawl a data tree")

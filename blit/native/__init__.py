@@ -1,0 +1,8 @@
+"""Native C++ sources for blit's acceleration libraries (SURVEY.md §2.3).
+
+This package carries no Python — it exists so the C++ sources, Makefile,
+and built artifacts (``build/*.so``) travel with the installed package
+(pyproject.toml package-data).  Build with ``make -C blit/native``;
+loading happens in :mod:`blit.io.native`, which degrades to NumPy
+fallbacks when the libraries are absent.
+"""

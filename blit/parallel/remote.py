@@ -75,10 +75,14 @@ def ssh_command(
     python: str = "python3",
     ssh_opts: Sequence[str] = ("-o", "BatchMode=yes"),
 ) -> List[str]:
-    """The production transport: ``ssh <host> <python> -m blit.agent``
-    (blit must be importable on the remote host, the analog of the
-    reference's shared ``@BLDistributedDataProducts`` project environment,
-    src/gbt.jl:17)."""
+    """The production transport: ``ssh <host> <python> -m blit.agent``.
+
+    blit must be importable on the remote host: deploy it with
+    ``pip install`` per docs/WORKFLOWS.md "Deploying to worker hosts" —
+    the packaged install (pyproject.toml) is the analog of the
+    reference's shared ``@BLDistributedDataProducts`` project environment
+    (src/gbt.jl:17).  ``agent_env_with_repo`` remains a dev/test
+    convenience for uninstalled checkouts."""
     return ["ssh", *ssh_opts, host, python, "-m", "blit.agent"]
 
 

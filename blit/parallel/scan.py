@@ -459,17 +459,24 @@ def reduce_scan_mesh_to_files(
     disk).  Returns ``{band_id: (path, header)}`` for the bands THIS
     process wrote.
 
-    ``resume=True`` (``.fil`` products only) makes the stream
-    crash-resumable, the mesh twin of ``RawReducer.reduce_resumable``:
-    a :class:`~blit.pipeline.ReductionCursor` sidecar per band records
+    ``resume=True`` makes the stream crash-resumable, the mesh twin of
+    ``RawReducer.reduce_resumable``: a
+    :class:`~blit.pipeline.ReductionCursor` sidecar per band records
     frames durably written after every window (data fsync'd before the
     cursor claims it); re-running truncates any un-checkpointed tail and
     continues from the last window boundary every process agrees on
     (pod-wide MIN, window-aligned — the restart offset must be identical
-    on every process or the collectives deadlock).  Cursor identity
-    covers the reduction config and this process's locally-fed member
-    files; the finished product is identical to an uninterrupted run and
-    the sidecars are removed on completion.
+    on every process or the collectives deadlock).  ``.fil`` products
+    truncate by byte length; ``.h5`` products ``resize``-truncate the
+    time-resizable dataset
+    (:class:`blit.io.fbh5.ResumableFBH5Writer`), including under
+    ``compression="bitshuffle"``, whose chunk rows are tied to the window
+    granularity so pod restart offsets stay chunk-aligned (a changed
+    ``window_frames`` therefore restarts bitshuffle ``.h5`` products
+    fresh — it is part of their cursor identity, as is the compression).
+    Cursor identity covers the reduction config and this process's
+    locally-fed member files; the finished product is identical to an
+    uninterrupted run and the sidecars are removed on completion.
     """
     import os
 
@@ -501,6 +508,17 @@ def reduce_scan_mesh_to_files(
         ]
     if len(out_paths) != nband:
         raise ValueError(f"need {nband} out_paths, got {len(out_paths)}")
+    if compression is not None:
+        bad = [p for p in out_paths if not p.endswith((".h5", ".hdf5"))]
+        if bad:
+            # Validate BEFORE any collective, on every process: a raise
+            # inside the per-band writer loop would fire only on band-
+            # owning processes and leave the rest blocked in the window
+            # loop's collectives (the deadlock the docstring warns about).
+            raise ValueError(
+                ".fil products are uncompressed; compression= needs .h5 "
+                f"paths, got {bad}"
+            )
 
     h0, bases, per_bank = _scan_headers(
         raws, local, nfft=nfft, nint=nint, stokes=stokes, fqav_by=fqav_by,
@@ -527,14 +545,29 @@ def reduce_scan_mesh_to_files(
     f0_start = 0
     cursors = {}
     if resume:
-        if compression is not None or any(
-            p.endswith((".h5", ".hdf5")) for p in out_paths
-        ):
-            raise ValueError("resume=True writes .fil (appendable) products")
+        import math
         from types import SimpleNamespace
 
         from blit.pipeline import ReductionCursor
 
+        comp_id = compression or "none"
+        # Mesh .h5-bitshuffle products tie the writer's chunk rows to the
+        # window granularity (the pod-wide restart offset is window-
+        # aligned, and bitshuffle resume points must be chunk-aligned), so
+        # the granularity joins the resume identity: a changed
+        # --window-frames restarts fresh instead of splicing mismatched
+        # chunk grids.  .fil and plain/gzip .h5 truncate at any row.
+        h5_chunk_rows = None
+        wrows_ident = -1
+        if comp_id == "bitshuffle" and any(
+            p.endswith((".h5", ".hdf5")) for p in out_paths
+        ):
+            from blit.io.fbh5 import default_chunks
+
+            wrows = wf // nint
+            base = default_chunks(nif, nchans, 4, whole_spectrum=True)[0]
+            h5_chunk_rows = math.gcd(base, wrows)
+            wrows_ident = wrows
         ident = SimpleNamespace(
             nfft=nfft, ntap=ntap, nint=nint, stokes=stokes, window=window,
             fqav_by=fqav_by, dtype="float32", despike_nfpc=despike_nfpc,
@@ -552,6 +585,8 @@ def reduce_scan_mesh_to_files(
             ok = (
                 cur is not None
                 and cur.matches(ident, members)
+                and cur.compression == comp_id
+                and cur.window_rows == wrows_ident
                 and os.path.exists(out_paths[b])
             )
             if not ok:
@@ -559,7 +594,8 @@ def reduce_scan_mesh_to_files(
                 cur = ReductionCursor(
                     members, nfft, ntap, nint, stokes, 0, window=window,
                     raw_size=size, raw_mtime_ns=mtime_ns, fqav_by=fqav_by,
-                    despike_nfpc=despike_nfpc,
+                    despike_nfpc=despike_nfpc, compression=comp_id,
+                    window_rows=wrows_ident,
                 )
             cursors[b] = cur
             local_done.append(cur.frames_done if ok else 0)
@@ -575,7 +611,19 @@ def reduce_scan_mesh_to_files(
     writers = {}
     try:
         for b in mine:
-            if resume:
+            if resume and out_paths[b].endswith((".h5", ".hdf5")):
+                from blit.io.fbh5 import ResumableFBH5Writer
+
+                writers[b] = ResumableFBH5Writer(
+                    out_paths[b], headers[b], nif, nchans,
+                    f0_start // nint, nint, cursors[b],
+                    compression=compression,
+                    chunks=(
+                        (h5_chunk_rows, nif, nchans)
+                        if h5_chunk_rows else None
+                    ),
+                )
+            elif resume:
                 from blit.pipeline import ResumableFilWriter
 
                 writers[b] = ResumableFilWriter(

@@ -484,31 +484,51 @@ class RawReducer:
         hdr["nsamps"] = w.nsamps
         return hdr
 
-    def reduce_resumable(self, raw_src: RawSource, out_path: str) -> Dict:
-        """Reduce to a ``.fil`` product with crash-resumable streaming.
+    def reduce_resumable(self, raw_src: RawSource, out_path: str,
+                         compression: Optional[str] = None,
+                         chunks: Optional[Tuple[int, int, int]] = None) -> Dict:
+        """Reduce to a ``.fil`` or ``.h5`` (FBH5) product with
+        crash-resumable streaming.
 
-        A :class:`ReductionCursor` sidecar records frames written after every
-        slab; re-running after an interruption truncates any un-checkpointed
-        tail and continues from the last completed chunk (block-boundary
-        restart, SURVEY.md §5 "Checkpoint / resume").  The finished product is
-        byte-identical to a non-resumed run; the sidecar is removed on
-        completion.  Multi-file scan sequences resume the same way — the
-        cursor records every member file's identity, and the skip-frames
-        restart lands wherever in the sequence the frames do (including
-        across a file boundary).
+        A :class:`ReductionCursor` sidecar records frames durably written
+        after every slab; re-running after an interruption truncates any
+        un-checkpointed tail and continues from the last completed chunk
+        (block-boundary restart, SURVEY.md §5 "Checkpoint / resume").  The
+        finished product's decoded payload is identical to a non-resumed
+        run; the sidecar is removed on completion.  Multi-file scan
+        sequences resume the same way — the cursor records every member
+        file's identity, and the skip-frames restart lands wherever in the
+        sequence the frames do (including across a file boundary).
+
+        ``.fil`` products truncate by byte length
+        (:class:`ResumableFilWriter`); ``.h5`` products ``resize``-truncate
+        the time-resizable dataset
+        (:class:`blit.io.fbh5.ResumableFBH5Writer` — BL's native product
+        format, src/gbtworkerfunctions.jl:141-155; under bitshuffle the
+        cursor claims only full-chunk-flushed rows, so a resume re-reduces
+        at most one chunk row).  ``compression``/``chunks`` apply to
+        ``.h5`` output only and are part of the resume identity.
         """
-        if out_path.endswith((".h5", ".hdf5")):
-            raise ValueError("reduce_resumable writes .fil (appendable) products")
+        is_h5 = out_path.endswith((".h5", ".hdf5"))
+        if not is_h5 and compression is not None:
+            raise ValueError(".fil products are uncompressed; compression "
+                             "applies to .h5 output")
+        if not is_h5 and chunks is not None:
+            raise ValueError("chunks applies to .h5 output")
         raw, hdr = self._open_validated(raw_src)
         # Cursor identity: the member path list (single files keep the plain
         # string so pre-existing sidecars stay valid).
         paths = getattr(raw, "paths", None) or raw.path
         nif = STOKES_NIF[self.stokes]
+        comp_id = compression or "none"
 
+        chunks_id = list(chunks) if chunks is not None else None
         cur = ReductionCursor.load(out_path)
         resuming = (
             cur is not None
             and cur.matches(self, paths)
+            and cur.compression == comp_id
+            and cur.chunks == chunks_id
             and os.path.exists(out_path)
         )
         if resuming:
@@ -519,11 +539,20 @@ class RawReducer:
                 paths, self.nfft, self.ntap, self.nint, self.stokes, 0,
                 window=self.window, raw_size=size, raw_mtime_ns=mtime_ns,
                 fqav_by=self.fqav_by, dtype=self.dtype,
+                compression=comp_id, chunks=chunks_id,
             )
         start_rows = cur.frames_done // self.nint if resuming else 0
-        w = ResumableFilWriter(
-            out_path, hdr, nif, hdr["nchans"], start_rows, self.nint, cur
-        )
+        if is_h5:
+            from blit.io.fbh5 import ResumableFBH5Writer
+
+            w = ResumableFBH5Writer(
+                out_path, hdr, nif, hdr["nchans"], start_rows, self.nint,
+                cur, compression=compression, chunks=chunks,
+            )
+        else:
+            w = ResumableFilWriter(
+                out_path, hdr, nif, hdr["nchans"], start_rows, self.nint, cur
+            )
         try:
             for slab in self.stream(raw, skip_frames=start_rows * self.nint):
                 w.append(slab)
@@ -651,6 +680,24 @@ class ReductionCursor:
     # of resume identity: splicing despiked and non-despiked spectra into
     # one product would corrupt it silently.
     despike_nfpc: int = -1
+    # Product compression ("none" | "gzip" | "bitshuffle") — .h5 resume
+    # identity: a dataset's filter pipeline is fixed at creation, so a
+    # writer expecting a different codec must start fresh, not corrupt.
+    # Compared at the call sites (not in matches(), whose `red` argument
+    # has no compression attribute).
+    compression: str = "none"
+    # Mesh .h5-bitshuffle resume identity: the writer's chunk rows derive
+    # from the window granularity, so a changed --window-frames must start
+    # fresh rather than hit the writer's chunk-mismatch refusal.  -1 =
+    # not applicable (.fil products and the single-chip path tolerate
+    # window changes).
+    window_rows: int = -1
+    # Explicit .h5 chunk shape (reduce_resumable's chunks= knob) — resume
+    # identity for the same reason as compression: a dataset's chunk grid
+    # is fixed at creation, so a resume under different chunks must start
+    # fresh, not die on the writer's chunk-mismatch refusal.  None = the
+    # writer's clamped default (deterministic for a given product shape).
+    chunks: Optional[List[int]] = None
 
     @staticmethod
     def stat_raw(raw_path: Union[str, Sequence[str]]) -> Tuple:

@@ -1,0 +1,35 @@
+"""Install-time hook that builds blit's native C++ libraries.
+
+All package metadata lives in pyproject.toml; this file exists only to
+compile ``blit/native`` (bitshuffle+LZ4 codec, GUPPI block reader) during
+``pip install`` / wheel builds.  The build is best-effort by design:
+blit degrades to its NumPy fallback paths when the libraries are absent
+(blit/io/native.py), so a host without a C++ toolchain still installs —
+it just reads bitshuffle files and RAW blocks more slowly.
+"""
+
+import os
+import subprocess
+import sys
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+
+class build_py_with_native(build_py):
+    def run(self):
+        native = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "blit", "native")
+        try:
+            subprocess.run(["make", "-C", native], check=True)
+        except (OSError, subprocess.CalledProcessError) as e:
+            print(
+                f"blit: native build skipped ({e}); the installed package "
+                "falls back to NumPy codec paths (build later with "
+                "`make -C blit/native` inside the installed tree)",
+                file=sys.stderr,
+            )
+        super().run()
+
+
+setup(cmdclass={"build_py": build_py_with_native})

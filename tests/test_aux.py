@@ -169,10 +169,16 @@ class TestResumableReduction:
                                  frames_done=2)
         assert not legacy.matches(red, raw)
 
-    def test_h5_rejected(self, tmp_path):
+    def test_fil_rejects_h5_only_options(self, tmp_path):
+        # .h5 resume is supported (tests/test_resume_fbh5.py); the .fil
+        # path still refuses the .h5-only knobs.
         raw, red = self._setup(tmp_path)
-        with pytest.raises(ValueError, match=r"\.fil"):
-            red.reduce_resumable(raw, str(tmp_path / "x.h5"))
+        with pytest.raises(ValueError, match="uncompressed"):
+            red.reduce_resumable(raw, str(tmp_path / "x.fil"),
+                                 compression="gzip")
+        with pytest.raises(ValueError, match="chunks"):
+            red.reduce_resumable(raw, str(tmp_path / "x.fil"),
+                                 chunks=(4, 1, 8))
 
     def test_skip_frames_matches_tail(self, tmp_path):
         from blit.io.guppi import GuppiRaw

@@ -1,0 +1,259 @@
+"""Crash-resumable FBH5 products (VERDICT r4 missing item 2): BL's native
+product format (src/gbtworkerfunctions.jl:141-155) must survive a crash the
+way ``.fil`` products do — cursor sidecar, resize-truncate to the last
+durable slab, decoded payload identical to an uninterrupted run."""
+
+import os
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+from blit.io.fbh5 import ResumableFBH5Writer, read_fbh5_data  # noqa: E402
+from blit.pipeline import RawReducer, ReductionCursor  # noqa: E402
+from blit.testing import synth_raw  # noqa: E402
+
+HDR = {"fch1": 8000.0, "foff": -0.1, "tsamp": 1.0, "nbits": 32,
+       "source_name": "SYNTH"}
+
+
+def make_red():
+    return RawReducer(nfft=64, nint=2, chunk_frames=4)
+
+
+@pytest.fixture
+def raw(tmp_path):
+    p = str(tmp_path / "x.raw")
+    synth_raw(p, nblocks=4, obsnchan=2, ntime_per_block=1024, tone_chan=1)
+    return p
+
+
+class Boom(Exception):
+    pass
+
+
+def crash_after(n_slabs):
+    """A RawReducer.stream wrapper that raises after yielding n slabs."""
+    orig = RawReducer.stream
+
+    def crashing(self, raw_, skip_frames=0):
+        for i, slab in enumerate(orig(self, raw_, skip_frames)):
+            if i == n_slabs:
+                raise Boom()
+            yield slab
+
+    return orig, crashing
+
+
+class TestWriterDurability:
+    """ResumableFBH5Writer's own contract, driven directly."""
+
+    def test_plain_checkpoints_every_append(self, tmp_path):
+        p = str(tmp_path / "x.h5")
+        cur = ReductionCursor(p, 64, 4, 2, "I")
+        w = ResumableFBH5Writer(p, HDR, 2, 16, 0, 2, cur)
+        data = np.random.default_rng(0).standard_normal(
+            (10, 2, 16)).astype(np.float32)
+        w.append(data[:6])
+        assert cur.frames_done == 12  # 6 rows * nint, claimed immediately
+        assert ReductionCursor.load(p).frames_done == 12
+        w.append(data[6:])
+        w.close()
+        np.testing.assert_array_equal(read_fbh5_data(p), data)
+        assert not os.path.exists(ReductionCursor.path_for(p))
+
+    def test_bitshuffle_claims_only_flushed_chunks(self, tmp_path):
+        pytest.importorskip("blit.io.bshuf").available() or pytest.skip(
+            "native codec unbuilt")
+        p = str(tmp_path / "x.h5")
+        cur = ReductionCursor(p, 64, 4, 2, "I")
+        w = ResumableFBH5Writer(p, HDR, 2, 16, 0, 2, cur,
+                                compression="bitshuffle",
+                                chunks=(4, 2, 16))
+        data = np.random.default_rng(1).standard_normal(
+            (11, 2, 16)).astype(np.float32)
+        w.append(data[:6])  # one full chunk (4) + 2 buffered
+        assert cur.frames_done == 4 * 2  # chunk-aligned claim only
+        w.append(data[6:9])  # 5 buffered -> one more chunk, 1 buffered
+        assert cur.frames_done == 8 * 2
+        # A crash here loses only the buffered row; the claim is durable.
+        w.abort()
+        cur2 = ReductionCursor.load(p)
+        assert cur2.frames_done == 16
+        # Resume from the claim and finish.
+        w2 = ResumableFBH5Writer(p, HDR, 2, 16, 8, 2, cur2,
+                                 compression="bitshuffle",
+                                 chunks=(4, 2, 16))
+        w2.append(data[8:])
+        w2.close()
+        np.testing.assert_array_equal(read_fbh5_data(p), data)
+
+    def test_resume_truncates_unclaimed_tail(self, tmp_path):
+        p = str(tmp_path / "x.h5")
+        cur = ReductionCursor(p, 64, 4, 2, "I")
+        w = ResumableFBH5Writer(p, HDR, 1, 8, 0, 2, cur)
+        a = np.arange(6 * 8, dtype=np.float32).reshape(6, 1, 8)
+        w.append(a)
+        w.abort()
+        # Tamper: pretend the last 2 rows were never claimed (crash between
+        # data landing and cursor save is the other direction and is
+        # covered by the fsync-before-cursor ordering).
+        cur2 = ReductionCursor.load(p)
+        start = (cur2.frames_done // 2) - 2
+        w2 = ResumableFBH5Writer(p, HDR, 1, 8, start, 2, cur2)
+        assert w2.nsamps == 4
+        b = 100 + np.arange(2 * 8, dtype=np.float32).reshape(2, 1, 8)
+        w2.append(b)
+        w2.close()
+        got = read_fbh5_data(p)
+        np.testing.assert_array_equal(got[:4], a[:4])
+        np.testing.assert_array_equal(got[4:], b)
+
+    def test_bitshuffle_refuses_misaligned_restart(self, tmp_path):
+        pytest.importorskip("blit.io.bshuf").available() or pytest.skip(
+            "native codec unbuilt")
+        p = str(tmp_path / "x.h5")
+        cur = ReductionCursor(p, 64, 4, 2, "I")
+        with pytest.raises(ValueError, match="aligned"):
+            ResumableFBH5Writer(p, HDR, 2, 16, 3, 2, cur,
+                                compression="bitshuffle", chunks=(4, 2, 16))
+
+    def test_resume_refuses_filter_mismatch(self, tmp_path):
+        pytest.importorskip("blit.io.bshuf").available() or pytest.skip(
+            "native codec unbuilt")
+        p = str(tmp_path / "x.h5")
+        cur = ReductionCursor(p, 64, 4, 2, "I")
+        w = ResumableFBH5Writer(p, HDR, 2, 16, 0, 2, cur, chunks=(4, 2, 16))
+        w.append(np.zeros((4, 2, 16), np.float32))
+        w.abort()
+        # Writing bitshuffle payloads through a plain pipeline would store
+        # undecodable chunks; the writer must refuse, not corrupt.
+        with pytest.raises(ValueError, match="filter"):
+            ResumableFBH5Writer(p, HDR, 2, 16, 4, 2,
+                                ReductionCursor.load(p),
+                                compression="bitshuffle", chunks=(4, 2, 16))
+
+
+class TestReduceResumableH5:
+    @pytest.mark.parametrize("compression", [None, "bitshuffle"])
+    def test_fresh_run_equals_plain_reduction(self, tmp_path, raw,
+                                              compression):
+        out = str(tmp_path / "x.h5")
+        hdr = make_red().reduce_resumable(raw, out, compression=compression)
+        _, want = make_red().reduce(raw)
+        np.testing.assert_array_equal(read_fbh5_data(out), want)
+        assert hdr["nsamps"] == want.shape[0]
+        assert not os.path.exists(ReductionCursor.path_for(out))
+
+    @pytest.mark.parametrize("compression", [None, "bitshuffle"])
+    def test_interrupted_run_resumes_identically(self, tmp_path, raw,
+                                                 compression):
+        out = str(tmp_path / "x.h5")
+        # chunks sized so each slab (chunk_frames=4 / nint=2 = 2 rows)
+        # flushes a whole bitshuffle chunk — the claim is then non-zero
+        # after one slab for both codecs.
+        chunks = (2, 1, 128)
+        orig, crashing = crash_after(1)
+        try:
+            RawReducer.stream = crashing
+            with pytest.raises(Boom):
+                make_red().reduce_resumable(raw, out,
+                                            compression=compression,
+                                            chunks=chunks)
+        finally:
+            RawReducer.stream = orig
+        cur = ReductionCursor.load(out)
+        assert cur is not None and cur.frames_done == 4  # one slab landed
+        assert cur.compression == (compression or "none")
+
+        make_red().reduce_resumable(raw, out, compression=compression,
+                                    chunks=chunks)
+        _, want = make_red().reduce(raw)
+        np.testing.assert_array_equal(read_fbh5_data(out), want)
+        assert not os.path.exists(ReductionCursor.path_for(out))
+
+    def test_bitshuffle_default_chunks_resume_restarts_clean(self, tmp_path,
+                                                             raw):
+        # With the default 16-row chunks a 2-row slab never completes a
+        # chunk before the crash: the claim is legitimately 0 and the
+        # resume is a clean fresh start, not a corrupt splice.
+        out = str(tmp_path / "x.h5")
+        orig, crashing = crash_after(1)
+        try:
+            RawReducer.stream = crashing
+            with pytest.raises(Boom):
+                make_red().reduce_resumable(raw, out,
+                                            compression="bitshuffle")
+        finally:
+            RawReducer.stream = orig
+        assert ReductionCursor.load(out).frames_done == 0
+        make_red().reduce_resumable(raw, out, compression="bitshuffle")
+        _, want = make_red().reduce(raw)
+        np.testing.assert_array_equal(read_fbh5_data(out), want)
+
+    def test_compression_flip_restarts_fresh(self, tmp_path, raw):
+        out = str(tmp_path / "x.h5")
+        orig, crashing = crash_after(1)
+        try:
+            RawReducer.stream = crashing
+            with pytest.raises(Boom):
+                make_red().reduce_resumable(raw, out)
+        finally:
+            RawReducer.stream = orig
+        # Same config, different codec: identity mismatch -> fresh start
+        # (NOT the writer's filter-mismatch refusal, and NOT corruption).
+        make_red().reduce_resumable(raw, out, compression="bitshuffle")
+        _, want = make_red().reduce(raw)
+        np.testing.assert_array_equal(read_fbh5_data(out), want)
+
+    def test_chunks_flip_restarts_fresh(self, tmp_path, raw):
+        # chunks= is part of the resume identity for the same reason as
+        # compression: the dataset's chunk grid is fixed at creation, so
+        # a mismatch must restart fresh — not die on the writer's
+        # chunk-mismatch refusal.
+        out = str(tmp_path / "x.h5")
+        orig, crashing = crash_after(1)
+        try:
+            RawReducer.stream = crashing
+            with pytest.raises(Boom):
+                make_red().reduce_resumable(raw, out, chunks=(2, 1, 128))
+        finally:
+            RawReducer.stream = orig
+        make_red().reduce_resumable(raw, out)  # default chunks
+        _, want = make_red().reduce(raw)
+        np.testing.assert_array_equal(read_fbh5_data(out), want)
+
+    def test_tampered_raw_restarts_fresh(self, tmp_path, raw):
+        out = str(tmp_path / "x.h5")
+        orig, crashing = crash_after(1)
+        try:
+            RawReducer.stream = crashing
+            with pytest.raises(Boom):
+                make_red().reduce_resumable(raw, out)
+        finally:
+            RawReducer.stream = orig
+        # Replace the recording with a DIFFERENT valid one (new mtime and
+        # payload): the cursor's input identity no longer matches, so the
+        # resume must restart fresh and reduce the new bytes.
+        synth_raw(raw, nblocks=4, obsnchan=2, ntime_per_block=1024,
+                  tone_chan=0, seed=7)
+        make_red().reduce_resumable(raw, out)
+        _, want = make_red().reduce(raw)
+        np.testing.assert_array_equal(read_fbh5_data(out), want)
+
+
+class TestCLI:
+    def test_reduce_resume_h5_bitshuffle(self, tmp_path, raw, capsys):
+        import json
+
+        from blit.__main__ import main
+
+        out = str(tmp_path / "x.h5")
+        rc = main(["reduce", raw, "-o", out, "--nfft", "64", "--nint", "2",
+                   "--compression", "bitshuffle", "--resume"])
+        assert rc == 0
+        stats = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        _, want = make_red().reduce(raw)
+        assert stats["nsamps"] == want.shape[0]
+        np.testing.assert_array_equal(read_fbh5_data(out), want)

@@ -358,10 +358,108 @@ class TestMeshResume:
         np.testing.assert_allclose(np.asarray(data), want, rtol=1e-4,
                                    atol=1.0)
 
-    def test_resume_rejects_h5(self, tree, tmp_path):
+    def test_h5_bitshuffle_interrupted_resumes_identically(
+        self, tree, tmp_path, monkeypatch
+    ):
+        # The native-format twin of the .fil resume above (VERDICT r4
+        # missing item 2): bitshuffle FBH5 band products crash-resume via
+        # resize-truncate, decoded payload identical to an uninterrupted
+        # run, with chunk rows tied to the window granularity so the
+        # pod-agreed restart offset stays chunk-aligned.
+        pytest.importorskip("blit.io.bshuf").available() or pytest.skip(
+            "native codec unbuilt")
+        from blit.io.fbh5 import read_fbh5_data
+        from blit.parallel import mesh as M
+
         _, invs = tree
-        with pytest.raises(ValueError, match="appendable"):
+        golden_dir = tmp_path / "golden"
+        golden_dir.mkdir()
+        self.run_resumable(invs, golden_dir, compression="bitshuffle")
+        golden = read_fbh5_data(str(golden_dir / "band0.h5"))
+
+        crash_dir = tmp_path / "crash"
+        crash_dir.mkdir()
+        real = M.band_reduce
+        calls = []
+
+        def flaky(*a, **kw):
+            calls.append(1)
+            if len(calls) == 3:
+                raise RuntimeError("synthetic crash")
+            return real(*a, **kw)
+
+        monkeypatch.setattr(M, "band_reduce", flaky)
+        with pytest.raises(RuntimeError, match="synthetic crash"):
+            self.run_resumable(invs, crash_dir, compression="bitshuffle")
+        out = crash_dir / "band0.h5"
+        assert out.exists() and (crash_dir / "band0.h5.cursor").exists()
+        partial = read_fbh5_data(str(out))
+        assert 0 < partial.shape[0] < golden.shape[0]
+
+        monkeypatch.setattr(M, "band_reduce", real)
+        written = self.run_resumable(invs, crash_dir,
+                                     compression="bitshuffle")
+        assert not (crash_dir / "band0.h5.cursor").exists()
+        np.testing.assert_array_equal(read_fbh5_data(str(out)), golden)
+        assert written[0][1]["nsamps"] == golden.shape[0]
+
+    def test_compression_with_fil_paths_rejected_before_collectives(
+        self, tree, tmp_path
+    ):
+        # The mismatch must raise on EVERY process before any collective
+        # (out_paths is globally known): a per-band raise would fire only
+        # on band-owning processes and deadlock the rest in the window
+        # loop.  Exercised here through explicit .fil out_paths.
+        _, invs = tree
+        with pytest.raises(ValueError, match="uncompressed"):
+            reduce_scan_mesh_to_files(
+                SESSION, SCAN, inventories=invs,
+                out_paths=[str(tmp_path / "band0.fil")],
+                nfft=NFFT, nint=NINT, window_frames=4,
+                compression="bitshuffle", resume=True,
+            )
+
+    def test_h5_window_change_restarts_fresh(self, tree, tmp_path,
+                                             monkeypatch):
+        # Bitshuffle .h5 chunk rows derive from the window granularity, so
+        # a resume under a different --window-frames must restart fresh
+        # (window_rows is part of the cursor identity), not die on the
+        # writer's chunk-mismatch refusal.
+        pytest.importorskip("blit.io.bshuf").available() or pytest.skip(
+            "native codec unbuilt")
+        from blit.io.fbh5 import read_fbh5_data
+        from blit.parallel import mesh as M
+
+        _, invs = tree
+        real = M.band_reduce
+        calls = []
+
+        def flaky(*a, **kw):
+            calls.append(1)
+            if len(calls) == 2:
+                raise RuntimeError("boom")
+            return real(*a, **kw)
+
+        monkeypatch.setattr(M, "band_reduce", flaky)
+        with pytest.raises(RuntimeError):
             self.run_resumable(invs, tmp_path, compression="bitshuffle")
+        monkeypatch.setattr(M, "band_reduce", real)
+        golden_dir = tmp_path / "golden"
+        golden_dir.mkdir()
+        reduce_scan_mesh_to_files(
+            SESSION, SCAN, inventories=invs, out_dir=str(golden_dir),
+            nfft=NFFT, nint=NINT, window_frames=6,
+            compression="bitshuffle",
+        )
+        reduce_scan_mesh_to_files(
+            SESSION, SCAN, inventories=invs, out_dir=str(tmp_path),
+            nfft=NFFT, nint=NINT, window_frames=6, resume=True,
+            compression="bitshuffle",
+        )
+        np.testing.assert_array_equal(
+            read_fbh5_data(str(tmp_path / "band0.h5")),
+            read_fbh5_data(str(golden_dir / "band0.h5")),
+        )
 
     def test_completed_resumable_equals_plain(self, tree, tmp_path):
         _, invs = tree
