@@ -56,10 +56,18 @@ def _cmd_reduce(args: argparse.Namespace) -> int:
 
 
 def _cmd_scan(args: argparse.Namespace) -> int:
+    from blit.config import default_window_frames
     from blit.inventory import get_inventory
+    from blit.observability import Timeline
     from blit.parallel.scan import reduce_scan_mesh_to_files
 
     invs = [get_inventory(args.file_re or r"\.raw$", root=args.root)]
+    # The EFFECTIVE window (library default + nint rounding), so the
+    # stats line reports what actually executed.
+    wf = (default_window_frames(args.nfft) if args.window_frames is None
+          else args.window_frames)
+    wf = max((wf // args.nint) * args.nint, args.nint)
+    tl = Timeline()
     written = reduce_scan_mesh_to_files(
         args.session,
         args.scan,
@@ -70,10 +78,12 @@ def _cmd_scan(args: argparse.Namespace) -> int:
         stokes=args.stokes,
         fqav_by=args.fqav,
         despike=not args.no_despike,
-        window_frames=args.window_frames,
+        window_frames=wf,
         max_frames=args.max_frames,
         compression=args.compression,
         resume=args.resume,
+        timeline=tl,
+        trace_logdir=args.trace_logdir,
     )
     for band, (path, hdr) in sorted(written.items()):
         print(
@@ -88,6 +98,8 @@ def _cmd_scan(args: argparse.Namespace) -> int:
                 }
             )
         )
+    # Per-stage throughput (read/device/readback/write), like blit reduce.
+    print(json.dumps({"window_frames": wf, "stages": tl.report()}))
     return 0
 
 
@@ -184,8 +196,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="per-chip frequency averaging before the stitch")
     ps.add_argument("--no-despike", action="store_true")
     ps.add_argument("--window-frames", type=int, default=None,
-                    help="PFB frames per device window (bounds HBM/host)")
+                    help="PFB frames per device window (bounds HBM, host "
+                         "RSS, and per-window readback).  Default: "
+                         "8*2^20 samples' worth of frames — i.e. "
+                         "max(8, 2^23/nfft), the dispatch size measured "
+                         "HBM-safe at the hi-res preset; raise it only "
+                         "if you have measured headroom")
     ps.add_argument("--max-frames", type=int, default=None)
+    ps.add_argument("--trace-logdir", default=None,
+                    help="write a JAX profiler trace of the window loop")
     ps.add_argument("--compression", default=None,
                     choices=["gzip", "bitshuffle"],
                     help="write .h5 (FBH5) band products with this codec")

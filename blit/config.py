@@ -86,6 +86,20 @@ class SiteConfig:
 
 DEFAULT = SiteConfig()
 
+# Default device-window budget in SAMPLES per chip for windowed mesh
+# reductions: 8 PFB frames at the hi-res preset (nfft=2^20) — the
+# production dispatch size the kernel pipeline was measured HBM-safe at
+# (DESIGN.md §3) — scaled to whole frames at other nfft.  Lives here (not
+# blit.parallel.scan) so the CLI can derive it without importing jax.
+WINDOW_SAMPLES = 8 << 20
+
+
+def default_window_frames(nfft: int) -> int:
+    """HBM-bounded default ``window_frames`` for a given ``nfft``: the
+    scan's device windows hold ~``WINDOW_SAMPLES`` samples per chip, with
+    a floor of 8 whole frames."""
+    return max(8, WINDOW_SAMPLES // nfft)
+
 
 def _compile(p) -> Pattern:
     """Accept str or compiled pattern for all regex-valued options."""

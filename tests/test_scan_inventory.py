@@ -283,6 +283,31 @@ class TestWindowEquivalenceFuzz:
         )
 
 
+class TestBoundedDefaultWindow:
+    def test_library_default_windows_the_scan(self, tree, tmp_path,
+                                              monkeypatch):
+        # window_frames=None must bound the device window at EVERY entry
+        # point, not just the CLI: the library derives the HBM-safe
+        # default from nfft.  (Shrunk here so the synthetic scan spans
+        # several windows; the product must still match one-shot.)
+        import blit.config as C
+        from blit.observability import Timeline
+
+        _, invs = tree
+        monkeypatch.setattr(C, "default_window_frames", lambda nfft: 4)
+        tl = Timeline()
+        written = reduce_scan_mesh_to_files(
+            SESSION, SCAN, inventories=invs, out_dir=str(tmp_path),
+            nfft=NFFT, nint=NINT, timeline=tl,
+        )
+        assert tl.stages["read"].calls > 1  # it actually windowed
+        _, out = load_scan_mesh(SESSION, SCAN, inventories=invs,
+                                nfft=NFFT, nint=NINT)
+        _, data = read_fil_data(written[0][0])
+        np.testing.assert_allclose(np.asarray(data), np.asarray(out)[0],
+                                   rtol=1e-4, atol=0.5)
+
+
 class TestMeshResume:
     def run_resumable(self, invs, outdir, **kw):
         return reduce_scan_mesh_to_files(
