@@ -392,10 +392,20 @@ def _run_collectives() -> dict:
                             B.weight_sharding(mesh))
         jax.block_until_ready(wp)
 
+        # bf16-resident planes: lossless for 8-bit RAW voltages, half the
+        # HBM reads (measured +26%, DESIGN.md §9 r5; ~1e-2 max rel err on
+        # detected power from weight rounding + bf16 partial sums).
+        vp16 = jax.tree.map(lambda a: a.astype(jnp.bfloat16), vp)
+        jax.block_until_ready(vp16)
+
         def bstep():
             return jnp.sum(B.beamform(vp, wp, mesh=mesh, nint=nint))
 
+        def bstep16():
+            return jnp.sum(B.beamform(vp16, wp, mesh=mesh, nint=nint))
+
         float(bstep())  # compile
+        float(bstep16())
         # These calls run ~10 ms each — far below the tunnel's ~100 ms
         # closing-fetch latency, which K=4 buried the measurement under
         # (round 3 reported 6.5 GB/s for a ~22 GB/s correlator; the
@@ -414,6 +424,14 @@ def _run_collectives() -> dict:
             "npol": npol, "nint": nint, "input_bytes": nbytes,
             "source": "raw_files",
         }
+        # Same voltages, bf16-resident: GB/s in f32-equivalent bytes so
+        # the two legs compare like-for-like (the bf16 planes MOVE half).
+        t0 = time.perf_counter()
+        acc = [bstep16() for _ in range(K)]
+        float(acc[-1])
+        el = time.perf_counter() - t0
+        out["beamform_bf16_gbps"] = round(nbytes * K / el / 1e9, 3)
+        del vp16
 
         # FX correlator: 8 antennas, PFB+DFT F-engine + full visibility matrix.
         nant, nchan, nfft, ntap, npol = 8, 64, 512, 4, 2
@@ -443,6 +461,60 @@ def _run_collectives() -> dict:
             "ntime": ntime, "npol": npol, "input_bytes": nbytes,
             "source": "raw_files",
         }
+
+        # FX correlator at ARRAY SCALE (VERDICT r4 item 1): 64 antennas —
+        # (nant*npol)^2 = 128^2 baseline tiles, exactly MXU-sized — through
+        # the packed-layout pallas X-engine (correlate(vis_layout="packed"),
+        # blit/ops/pallas_xengine.py; measured +19% over the einsum
+        # X-engine at this shape, DESIGN.md §9 r5 addendum).  nchan=16
+        # keeps visibilities + spectra + inputs comfortably inside HBM.
+        nant, nchan, nfft, ntap, npol = 64, 16, 512, 4, 2
+        ntime = 64 * nfft
+        h = jnp.asarray(pfb_coeffs(ntap, nfft))  # local: don't lean on the
+        # nant=8 section happening to share (ntap, nfft)
+        paths = ant_files("fx64", nant, nchan, ntime)
+        t0 = time.perf_counter()
+        _chdr, cvp = A.load_correlator_mesh(
+            paths, mesh=mesh, nfft=nfft, ntap=ntap, max_samples=ntime,
+        )
+        jax.block_until_ready(cvp)
+        out["rig_correlator64_load_s"] = round(time.perf_counter() - t0, 3)
+
+        cvp16 = jax.tree.map(lambda a: a.astype(jnp.bfloat16), cvp)
+        jax.block_until_ready(cvp16)
+
+        def c64step():
+            visr, visi = C.correlate(cvp, h, mesh=mesh, nfft=nfft,
+                                     ntap=ntap, vis_layout="packed")
+            return jnp.sum(visr) + jnp.sum(visi)
+
+        def c64step16():
+            visr, visi = C.correlate(cvp16, h, mesh=mesh, nfft=nfft,
+                                     ntap=ntap, vis_layout="packed")
+            return jnp.sum(visr) + jnp.sum(visi)
+
+        float(c64step())
+        float(c64step16())
+        K64 = 24  # ~21 ms/call: K*c >= 400 ms amortizes the closing fetch
+        t0 = time.perf_counter()
+        acc = [c64step() for _ in range(K64)]
+        float(acc[-1])
+        el = time.perf_counter() - t0
+        nbytes = cvp[0].nbytes + cvp[1].nbytes
+        out["correlator64_gbps"] = round(nbytes * K64 / el / 1e9, 3)
+        out["correlator64_config"] = {
+            "nant": nant, "nchan": nchan, "nfft": nfft, "ntap": ntap,
+            "ntime": ntime, "npol": npol, "input_bytes": nbytes,
+            "vis_layout": "packed", "x_engine": "pallas",
+            "source": "raw_files",
+        }
+        # bf16-staged (f32-equivalent bytes; measured +25% in the
+        # controlled A/B — DESIGN.md §9 r5 addendum).
+        t0 = time.perf_counter()
+        acc = [c64step16() for _ in range(K64)]
+        float(acc[-1])
+        el = time.perf_counter() - t0
+        out["correlator64_bf16_gbps"] = round(nbytes * K64 / el / 1e9, 3)
         return out
     finally:
         # RAM-backed fixtures must not outlive the run, success or

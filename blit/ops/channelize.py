@@ -179,6 +179,13 @@ def fft_planar(
             fi = fi.astype(dtype)
         return dftmod.dft(fr, fi, precision=precision, dtype=dtype,
                           order=order)
+    # Complex-FFT backends (CPU/GPU) reject bf16 planes: upcast those —
+    # the bf16-staged collective paths stay correct off-TPU, they just
+    # lose the traffic saving the TPU matmul path keeps.  (Only bf16:
+    # f64 planes must keep flowing into a complex128 FFT.)
+    if fr.dtype == jnp.bfloat16:
+        fr = fr.astype(jnp.float32)
+        fi = fi.astype(jnp.float32)
     z = fft(jax.lax.complex(fr, fi), method=method)
     return jnp.real(z), jnp.imag(z)
 

@@ -30,6 +30,18 @@ Planar = Tuple["object", "object"]
 _ERR = 1 << 60  # rides the pod-wide agreement; see scan._SAMPS_ERR
 
 
+def _resolve_plane_dtype(dtype):
+    """Device residency dtype for the planar loaders: f32 or bf16 (bf16
+    is lossless for 8-bit RAW voltages and halves HBM/ICI traffic in the
+    collectives — DESIGN.md §9 r5 addendum)."""
+    import jax.numpy as jnp
+
+    d = jnp.dtype(dtype)
+    if d not in (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16)):
+        raise ValueError(f"dtype must be float32 or bfloat16, got {dtype}")
+    return d
+
+
 def _open_antennas(raw_paths: Sequence, needed: Sequence[int]):
     """Open the antenna recordings in ``needed`` (indices into
     ``raw_paths``) and agree (samples, nchan, npol) pod-wide with
@@ -103,6 +115,7 @@ def load_antennas_mesh(
     mesh,
     axis: str = "bank",
     max_samples: Optional[int] = None,
+    dtype="float32",
 ) -> Tuple[Dict, Planar]:
     """Load per-antenna RAW recordings onto the beamform layout:
     ``(nant, nchan, ntime, npol)`` planar voltages with the antenna axis
@@ -116,10 +129,20 @@ def load_antennas_mesh(
 
     ``raw_paths``: one RAW source per antenna (path / ``.NNNN.raw`` stem /
     path list), length divisible by the ``axis`` mesh size.
+
+    ``dtype``: device residency of the planes — ``"float32"`` (default)
+    or ``"bfloat16"``.  RAW voltages are 8-bit integers, exactly
+    representable in bf16, so bf16 residency is LOSSLESS for the data
+    plane and halves both HBM reads and ICI psum bytes downstream
+    (:func:`blit.parallel.beamform.beamform` runs its whole contraction
+    in bf16 for bf16 inputs — measured +26% end-to-end, DESIGN.md §9 r5
+    addendum).
     """
     import jax
 
     from blit.parallel.beamform import antenna_sharding
+
+    dev_dtype = _resolve_plane_dtype(dtype)
 
     nant = len(raw_paths)
     ax_size = mesh.shape[axis]
@@ -149,8 +172,9 @@ def load_antennas_mesh(
         bi = np.empty_like(br)
         for j, a in enumerate(range(lo, hi)):
             br[j], bi[j] = _planar_block(raws[a], 0, ntime)
-        shards_r.append(jax.device_put(br, d))
-        shards_i.append(jax.device_put(bi, d))
+        # int8-origin values are exact in bf16: the cast loses nothing.
+        shards_r.append(jax.device_put(br.astype(dev_dtype, copy=False), d))
+        shards_i.append(jax.device_put(bi.astype(dev_dtype, copy=False), d))
     global_shape = (nant, nchan, ntime, npol)
     vr = jax.make_array_from_single_device_arrays(
         global_shape, sharding, shards_r
@@ -177,6 +201,7 @@ def load_correlator_mesh(
     nfft: int,
     ntap: int = 4,
     max_samples: Optional[int] = None,
+    dtype="float32",
 ) -> Tuple[Dict, Planar]:
     """Load per-antenna RAW recordings onto the FX-correlator layout:
     ``(nant, nchan, ntime, npol)`` planar voltages with frequency sharded
@@ -190,10 +215,16 @@ def load_correlator_mesh(
     bytes because RAW blocks interleave all channels).  Each band row's
     segment is trimmed to whole ``nfft`` blocks with at least ``ntap``
     of them, matching ``correlate``'s segment semantics.
+
+    ``dtype``: ``"float32"`` (default) or ``"bfloat16"`` residency — see
+    :func:`load_antennas_mesh`; ``correlate`` runs its bf16-staged path
+    for bf16 planes (measured +25% at nant=64, DESIGN.md §9 r5).
     """
     import jax
 
     from blit.parallel.correlator import correlator_sharding
+
+    dev_dtype = _resolve_plane_dtype(dtype)
 
     nant = len(raw_paths)
     nband = mesh.shape["band"]
@@ -238,8 +269,8 @@ def load_correlator_mesh(
                            for a in range(nant)])
             bi = np.stack([blocks[a][1][k * cper:(k + 1) * cper]
                            for a in range(nant)])
-            shards_r.append(jax.device_put(br, d))
-            shards_i.append(jax.device_put(bi, d))
+            shards_r.append(jax.device_put(br.astype(dev_dtype, copy=False), d))
+            shards_i.append(jax.device_put(bi.astype(dev_dtype, copy=False), d))
         del blocks
     global_shape = (nant, nchan, ntime, npol)
     vr = jax.make_array_from_single_device_arrays(

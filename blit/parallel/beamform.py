@@ -128,12 +128,25 @@ def beamform(
     vr, vi, v_cplx = as_planar(voltages)
     wr, wi, w_cplx = as_planar(weights)
     complex_out = v_cplx and w_cplx
+    # bf16-RESIDENT voltages run the whole contraction + psum in bf16
+    # (measured +26% end-to-end at the bench shape, DESIGN.md §9 r5
+    # addendum: half the HBM voltage reads and half the ICI psum bytes;
+    # 8-bit RAW samples are exact in bf16, the MXU multiplies at bf16
+    # precision either way, so the only new rounding is the weight
+    # phasors and the bf16 partial sums — ~1e-2 max rel err on detected
+    # power).  Opt in by loading bf16 planes
+    # (``load_antennas_mesh(dtype="bfloat16")``).
+    bf16 = vr.dtype == jnp.bfloat16
 
     def step(vr, vi, wr, wi):
+        if bf16:
+            wr, wi = wr.astype(jnp.bfloat16), wi.astype(jnp.bfloat16)
         br, bi = _local_beams_planar(vr, vi, wr, wi)
         br, bi = jax.lax.psum((br, bi), axis)
         if detect:
-            return integrate((br**2 + bi**2).astype(jnp.float32), nint)
+            br = br.astype(jnp.float32)
+            bi = bi.astype(jnp.float32)
+            return integrate(br**2 + bi**2, nint)
         return br, bi
 
     out_specs = P() if detect else (P(), P())

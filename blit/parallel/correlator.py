@@ -59,9 +59,11 @@ def f_engine_planar(
     ntap, nfft = coeffs.shape
     if nfft % 2:
         raise ValueError("f_engine_planar: nfft must be even")
+    # ±1 is exact in every float dtype: follow the coeffs (bf16 coeffs
+    # must not promote the whole FIR back to f32).
     sign = jnp.asarray(
         np.where(np.arange(nfft) % 2 == 0, 1.0, -1.0).astype(np.float32)
-    )
+    ).astype(coeffs.dtype)
     shifted = coeffs * sign[None, :]
     fr = pfb_frontend(vr, shifted)
     fi = pfb_frontend(vi, shifted)
@@ -82,6 +84,8 @@ def _xengine_planar(sr: jax.Array, si: jax.Array) -> Planar:
     ``V[a,b] = Σ_t S_a S_b*``: with planar S the real part is
     ``Σ (ar·br + ai·bi)`` and the imaginary part ``Σ (ai·br − ar·bi)`` —
     4 real batched einsums (MXU) instead of one complex einsum.
+    Accumulation is pinned to f32 so bf16 spectra (the bf16-staged path)
+    integrate losslessly.
 
     Measured dead end (DESIGN.md §9 round-4 addendum): computing all four
     block products as ONE einsum over the re/im-stacked operand (a
@@ -91,15 +95,45 @@ def _xengine_planar(sr: jax.Array, si: jax.Array) -> Planar:
     concatenate materializes an extra copy of both spectra planes, and
     the MXU tiles were not the binding resource.
     """
-    rr = jnp.einsum("acptf,bcqtf->abcfpq", sr, sr)
-    ii = jnp.einsum("acptf,bcqtf->abcfpq", si, si)
-    ir = jnp.einsum("acptf,bcqtf->abcfpq", si, sr)
-    ri = jnp.einsum("acptf,bcqtf->abcfpq", sr, si)
+    kw = dict(preferred_element_type=jnp.float32)
+    rr = jnp.einsum("acptf,bcqtf->abcfpq", sr, sr, **kw)
+    ii = jnp.einsum("acptf,bcqtf->abcfpq", si, si, **kw)
+    ir = jnp.einsum("acptf,bcqtf->abcfpq", si, sr, **kw)
+    ri = jnp.einsum("acptf,bcqtf->abcfpq", sr, si, **kw)
+    return rr + ii, ir - ri
+
+
+def _xengine_packed(sr: jax.Array, si: jax.Array) -> Planar:
+    """X-engine emitting the packed ``(c, f, a, p, b, q)`` layout.
+
+    On TPU backends at MXU-sized baseline counts this is the VMEM-resident
+    Pallas kernel (blit/ops/pallas_xengine.py — measured +19% on the whole
+    correlate call at nant=64, the un-parking of DESIGN.md §9's round-4
+    decision); elsewhere, packed-layout einsums (measured at parity with
+    the standard layout, tools/ab_fx64.py, so the fallback costs nothing).
+    """
+    from blit.ops import pallas_xengine
+    from blit.ops.channelize import _MATMUL_ONLY_BACKENDS
+
+    nant, _c, npol = sr.shape[0], sr.shape[1], sr.shape[2]
+    nap = nant * npol
+    if (
+        jax.default_backend() in _MATMUL_ONLY_BACKENDS
+        and pallas_xengine.eligible(nap, sr.shape[-1], sr.shape[3])
+    ):
+        vr, vi = pallas_xengine.xengine_packed(sr, si)
+        shape6 = vr.shape[:2] + (nant, npol, nant, npol)
+        return vr.reshape(shape6), vi.reshape(shape6)
+    kw = dict(preferred_element_type=jnp.float32)
+    rr = jnp.einsum("acptf,bcqtf->cfapbq", sr, sr, **kw)
+    ii = jnp.einsum("acptf,bcqtf->cfapbq", si, si, **kw)
+    ir = jnp.einsum("acptf,bcqtf->cfapbq", si, sr, **kw)
+    ri = jnp.einsum("acptf,bcqtf->cfapbq", sr, si, **kw)
     return rr + ii, ir - ri
 
 
 @functools.partial(
-    jax.jit, static_argnames=("mesh", "nfft", "ntap")
+    jax.jit, static_argnames=("mesh", "nfft", "ntap", "vis_layout")
 )
 def correlate(
     voltages: ComplexOrPlanar,
@@ -108,6 +142,7 @@ def correlate(
     mesh: Mesh,
     nfft: int,
     ntap: int = 4,
+    vis_layout: str = "standard",
 ):
     """Full FX correlation over the mesh.
 
@@ -118,13 +153,21 @@ def correlate(
         ``band`` (see :func:`correlator_sharding`); ``ntime`` per band must
         be a multiple of ``nfft`` with at least ``ntap`` blocks.
       coeffs: (ntap, nfft) PFB prototype (replicated).
+      vis_layout: ``"standard"`` → ``(nant, nant, nchan, nfft, npol,
+        npol)``; ``"packed"`` → ``(nchan, nfft, nant, npol, nant, npol)``,
+        the TPU-fast layout emitted directly by the VMEM-resident Pallas
+        X-engine at MXU-sized baseline counts (nant·npol >= 128; +19%
+        whole-call at nant=64 — transposing to the standard layout would
+        move 2×vis bytes and eat the win, so the layout is the opt-in).
+        Integrations and layout-indifferent reductions should prefer it
+        at array scale.
 
     Returns:
-      Visibilities ``(nant, nant, nchan, nfft, npol, npol)`` integrated over
-      *all* time (psum over ``band``), with the fine-channel axes sharded
-      over ``bank`` like the input — complex64 when the input was complex,
-      else a planar float32 pair.  Entry ``[a, b]`` is ``⟨S_a S_b*⟩``; the
-      diagonal holds autocorrelation spectra.
+      Visibilities integrated over *all* time (psum over ``band``), with
+      the channel axes sharded over ``bank`` like the input — complex64
+      when the input was complex, else a planar float32 pair.  Entry
+      ``[a, b]`` (standard) or ``[c, f, a, p, b, q]`` (packed) is
+      ``⟨S_a S_b*⟩``; the antenna diagonal holds autocorrelation spectra.
 
     Segment semantics: each band row F-engines its time segment
     independently — the PFB does not run across segment boundaries, so
@@ -132,19 +175,39 @@ def correlate(
     correlator behavior; :func:`correlate_np` with ``nsegments=nband`` is
     the exact golden reference).
     """
+    if vis_layout not in ("standard", "packed"):
+        raise ValueError(f"bad vis_layout {vis_layout!r}")
     vr, vi, was_complex = as_planar(voltages)
+    # bf16-RESIDENT voltages run the F-engine and spectra in bf16
+    # (measured +25% end-to-end at nant=64, DESIGN.md §9 r5 addendum:
+    # 8-bit RAW samples are exact in bf16, and the MXU truncates f32
+    # operands to bf16 anyway — bf16 SPECTRA alone measured visibilities
+    # byte-identical to the f32-spectra path).  Visibilities always
+    # accumulate and psum in f32.  Opt in by loading bf16 planes
+    # (``load_correlator_mesh(dtype="bfloat16")``).
+    bf16 = vr.dtype == jnp.bfloat16
 
     def step(vr, vi, h):
+        if bf16:
+            h = h.astype(jnp.bfloat16)
         # v: (nant, nchan_local, ntime_local, npol) — move pol before time so
         # the F-engine framing acts on the last axis.
         sr, si = f_engine_planar(
             jnp.moveaxis(vr, 3, 2), jnp.moveaxis(vi, 3, 2), h
         )  # (a, c, p, frames, nfft) each
-        visr, visi = _xengine_planar(sr, si)
+        if bf16:
+            sr = sr.astype(jnp.bfloat16)
+            si = si.astype(jnp.bfloat16)
+        if vis_layout == "packed":
+            visr, visi = _xengine_packed(sr, si)
+        else:
+            visr, visi = _xengine_planar(sr, si)
         return jax.lax.psum((visr, visi), BAND_AXIS)
 
     spec_v = P(None, BANK_AXIS, BAND_AXIS)
-    out_spec = P(None, None, BANK_AXIS)
+    out_spec = (
+        P(BANK_AXIS) if vis_layout == "packed" else P(None, None, BANK_AXIS)
+    )
     visr, visi = jax.shard_map(
         step,
         mesh=mesh,

@@ -87,6 +87,57 @@ class TestBeamform:
         )
 
 
+class TestBeamformBf16:
+    def test_bf16_resident_matches_f32(self):
+        # bf16-resident planes (load_antennas_mesh(dtype="bfloat16")) run
+        # the contraction + psum in bf16 (measured +26% on the chip,
+        # DESIGN.md §9 r5).  8-bit voltages are exact in bf16; rounding
+        # comes from the weight phasors and the bf16 partial sums —
+        # ~1e-2 max rel err on detected power.
+        nant, nbeam, nchan, ntime = 8, 5, 4, 64
+        rng = np.random.default_rng(7)
+        v8 = rng.integers(-40, 41, (2, nant, nchan, ntime, 2)).astype(
+            np.float32
+        )
+        wr, wi = B.delay_weights_planar(
+            jnp.asarray(rng.uniform(0, 1e-9, (nbeam, nant))),
+            jnp.asarray(np.linspace(1e9, 1.1e9, nchan)),
+        )
+        m = make_mesh(1, 8)
+        wp = jax.device_put((np.asarray(wr), np.asarray(wi)),
+                            B.weight_sharding(m))
+        vp32 = jax.device_put((v8[0], v8[1]), B.antenna_sharding(m))
+        vp16 = jax.device_put(
+            (v8[0].astype(jnp.bfloat16), v8[1].astype(jnp.bfloat16)),
+            B.antenna_sharding(m),
+        )
+        p32 = np.asarray(B.beamform(vp32, wp, mesh=m, nint=4))
+        p16 = np.asarray(B.beamform(vp16, wp, mesh=m, nint=4))
+        assert p16.dtype == np.float32  # detection always comes back f32
+        np.testing.assert_allclose(p16, p32, rtol=3e-2,
+                                   atol=3e-2 * np.abs(p32).max())
+
+    def test_loader_bf16_residency(self, tmp_path):
+        from blit.parallel.antenna import load_antennas_mesh
+        from blit.testing import synth_raw
+
+        paths = []
+        for a in range(8):
+            p = str(tmp_path / f"a{a}.raw")
+            synth_raw(p, nblocks=1, obsnchan=2, ntime_per_block=64, seed=a)
+            paths.append(p)
+        m = make_mesh(1, 8)
+        _, (vr, vi) = load_antennas_mesh(paths, mesh=m, dtype="bfloat16")
+        assert vr.dtype == jnp.bfloat16 and vi.dtype == jnp.bfloat16
+        # Lossless: the bf16 planes decode to the same int8-origin values.
+        _, (fr, fi) = load_antennas_mesh(paths, mesh=m)
+        np.testing.assert_array_equal(
+            np.asarray(vr).astype(np.float32), np.asarray(fr)
+        )
+        with pytest.raises(ValueError, match="dtype"):
+            load_antennas_mesh(paths, mesh=m, dtype="float16")
+
+
 class TestBeamformPlanar:
     """The TPU-native planar (re, im) input path (complex-free backend)."""
 
@@ -184,6 +235,90 @@ class TestCorrelator:
         want = C.correlate_np(v, h, nfft=nfft, ntap=ntap, nsegments=nband)
         np.testing.assert_allclose(np.asarray(visr), want.real, rtol=1e-3, atol=1e-2)
         np.testing.assert_allclose(np.asarray(visi), want.imag, rtol=1e-3, atol=1e-2)
+
+    @pytest.mark.parametrize("nband,nbank", [(1, 8), (2, 4)])
+    def test_packed_layout_matches_standard(self, nband, nbank):
+        # vis_layout="packed" is the TPU-fast layout (pallas X-engine at
+        # MXU-sized nap; packed einsums elsewhere — this CPU mesh takes
+        # the einsum fallback).  Same numbers, axes (c,f,a,p,b,q).
+        nfft, ntap = 16, 4
+        nant, nchan = 3, 8
+        ntime = nband * 8 * nfft
+        v = make_antenna_voltages(nant=nant, nchan=nchan, ntime=ntime,
+                                  seed=13)
+        h = pfb_coeffs(ntap, nfft)
+        m = make_mesh(nband, nbank)
+        vs = jax.device_put(v, C.correlator_sharding(m))
+        std = np.asarray(
+            C.correlate(vs, jnp.asarray(h), mesh=m, nfft=nfft, ntap=ntap)
+        )
+        packed = np.asarray(C.correlate(
+            vs, jnp.asarray(h), mesh=m, nfft=nfft, ntap=ntap,
+            vis_layout="packed",
+        ))
+        assert packed.shape == (nchan, nfft, nant, 2, nant, 2)
+        np.testing.assert_allclose(
+            packed, std.transpose(2, 3, 0, 4, 1, 5), rtol=1e-5, atol=1e-5
+        )
+
+    @pytest.mark.parametrize("vis_layout", ["standard", "packed"])
+    def test_bf16_resident_matches_f32(self, vis_layout):
+        # bf16-resident voltages run the bf16-staged path (bf16 FIR +
+        # bf16 spectra, f32 accumulation — measured +25% at nant=64,
+        # DESIGN.md §9 r5).  On this CPU mesh the f32 reference computes
+        # exact f32 (no MXU truncation), so the tolerance covers the
+        # bf16 rounding the chip applies to BOTH paths anyway.
+        nfft, ntap = 16, 4
+        nant, nchan = 3, 8
+        ntime = 8 * nfft
+        rng = np.random.default_rng(23)
+        v8 = rng.integers(-40, 41, (2, nant, nchan, ntime, 2)).astype(
+            np.float32
+        )
+        h = pfb_coeffs(ntap, nfft)
+        m = make_mesh(1, 8)
+        vp32 = jax.device_put((v8[0], v8[1]), C.correlator_sharding(m))
+        vp16 = jax.device_put(
+            (v8[0].astype(jnp.bfloat16), v8[1].astype(jnp.bfloat16)),
+            C.correlator_sharding(m),
+        )
+        kw = dict(mesh=m, nfft=nfft, ntap=ntap, vis_layout=vis_layout)
+        r32, i32 = C.correlate(vp32, jnp.asarray(h), **kw)
+        r16, i16 = C.correlate(vp16, jnp.asarray(h), **kw)
+        assert r16.dtype == jnp.float32  # visibilities accumulate f32
+        scale = float(np.abs(np.asarray(r32)).max())
+        np.testing.assert_allclose(np.asarray(r16), np.asarray(r32),
+                                   rtol=2e-2, atol=2e-2 * scale)
+        np.testing.assert_allclose(np.asarray(i16), np.asarray(i32),
+                                   rtol=2e-2, atol=2e-2 * scale)
+
+    def test_loader_bf16_residency(self, tmp_path):
+        from blit.parallel.antenna import load_correlator_mesh
+        from blit.testing import synth_raw
+
+        paths = []
+        for a in range(3):
+            p = str(tmp_path / f"c{a}.raw")
+            synth_raw(p, nblocks=2, obsnchan=4, ntime_per_block=512, seed=a)
+            paths.append(p)
+        m = make_mesh(2, 4)
+        _, (vr, vi) = load_correlator_mesh(paths, mesh=m, nfft=64,
+                                           dtype="bfloat16")
+        assert vr.dtype == jnp.bfloat16 and vi.dtype == jnp.bfloat16
+        _, (fr, fi) = load_correlator_mesh(paths, mesh=m, nfft=64)
+        np.testing.assert_array_equal(
+            np.asarray(vr).astype(np.float32), np.asarray(fr)
+        )
+
+    def test_bad_vis_layout_rejected(self):
+        m = make_mesh(1, 8)
+        v = make_antenna_voltages(nant=2, nchan=8, ntime=8 * 16, seed=1)
+        with pytest.raises(ValueError, match="vis_layout"):
+            C.correlate(
+                jax.device_put(v, C.correlator_sharding(m)),
+                jnp.asarray(pfb_coeffs(4, 16)), mesh=m, nfft=16,
+                vis_layout="fast",
+            )
 
     def test_correlated_signal_shows_fringe(self):
         # Identical signal in two antennas → cross-power == auto-power.

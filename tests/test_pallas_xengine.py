@@ -1,0 +1,62 @@
+"""VMEM-resident packed X-engine (blit/ops/pallas_xengine.py), interpret
+mode — the kernel behind ``correlate(vis_layout="packed")`` at MXU-sized
+baseline counts (nant·npol >= 128; measured +19% whole-call at nant=64,
+DESIGN.md §9 round-5 addendum)."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from blit.ops.pallas_xengine import eligible, xengine_packed  # noqa: E402
+
+
+def golden_packed(sr, si):
+    s = sr + 1j * si
+    nchan, nfft = s.shape[1], s.shape[4]
+    nap = s.shape[0] * s.shape[2]
+    vis = np.einsum("acptf,bcqtf->cfapbq", s, np.conj(s))
+    return vis.reshape(nchan, nfft, nap, nap)
+
+
+class TestKernel:
+    @pytest.mark.parametrize("nant,nchan,nfft,nframes,ft", [
+        (4, 2, 16, 13, 8),     # several grid steps both axes
+        (4, 1, 8, 5, 8),       # single chan, one fine tile
+        (8, 3, 32, 6, 16),     # wider tile, odd chan count
+    ])
+    def test_matches_einsum(self, nant, nchan, nfft, nframes, ft):
+        rng = np.random.default_rng(nant + nfft)
+        shape = (nant, nchan, 2, nframes, nfft)
+        sr = rng.standard_normal(shape).astype(np.float32)
+        si = rng.standard_normal(shape).astype(np.float32)
+        vr, vi = xengine_packed(jnp.asarray(sr), jnp.asarray(si), ft=ft,
+                                interpret=True)
+        want = golden_packed(sr, si)
+        np.testing.assert_allclose(np.asarray(vr), want.real, rtol=1e-4,
+                                   atol=1e-3)
+        np.testing.assert_allclose(np.asarray(vi), want.imag, rtol=1e-4,
+                                   atol=1e-3)
+
+    def test_indivisible_nfft_rejected(self):
+        s = jnp.zeros((2, 1, 2, 5, 12), jnp.float32)
+        with pytest.raises(ValueError, match="fine tiles"):
+            xengine_packed(s, s, ft=8, interpret=True)
+
+
+class TestEligibility:
+    def test_mxu_sized_gate(self):
+        # The production gate: pallas only where it measured faster
+        # (nap >= 128); the nant=8 shape stays on the einsum path.
+        assert eligible(128, 512, 61)
+        assert eligible(256, 512, 61)
+        assert not eligible(16, 512, 61)       # nant=8 bench shape
+        assert not eligible(128, 500, 61)      # fine tiles must divide
+
+    def test_vmem_bound(self):
+        # Long time segments grow the input blocks with nframes: those
+        # must fall back to the einsum path, not compile-fail (the
+        # measured OOM: ft=32-equivalent footprints past ~16 MB scoped).
+        assert eligible(128, 512, 512)
+        assert not eligible(128, 512, 2045)

@@ -1,0 +1,151 @@
+"""Pallas VMEM-resident X-engine prototype vs the production einsum
+X-engine, interleaved on-chip at nant=64 (VERDICT r4 item 1: "build the
+VMEM-resident X-engine if the measured shape justifies it, or record the
+dead end at that shape").
+
+The kernel consumes spectra pre-transposed (ONE XLA pass) to
+``(nchan, nfft, nant*npol, nframes)`` and emits packed visibilities
+``(nchan, nfft, ap, bq)``: per (chan, fine-tile) grid step it loads both
+planes' (FT, 128, nframes) blocks into VMEM and runs 4 batched
+dot_generals — every spectra byte is read exactly once, every visibility
+byte written once.  tools/ab_fx64.py already measured packed-layout
+OUTPUT parity for the einsum path, so the packed emission is not the
+variable under test; the single-pass VMEM residency is.
+
+Run on the TPU rig:  python tools/ab_fx64_pallas.py [nant nchan nfft nblk rounds reps ft]
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def make_xengine_pallas(nchan, nfft, nap, nframes, ft):
+    from jax.experimental import pallas as pl
+
+    def kernel(ar_ref, ai_ref, vr_ref, vi_ref):
+        ar = ar_ref[0]  # (ft, nap, nframes)
+        ai = ai_ref[0]
+        dn = (((2,), (2,)), ((0,), (0,)))  # contract frames, batch fine
+        rr = jax.lax.dot_general(ar, ar, dn)
+        ii = jax.lax.dot_general(ai, ai, dn)
+        ir = jax.lax.dot_general(ai, ar, dn)
+        ri = jax.lax.dot_general(ar, ai, dn)
+        vr_ref[0] = rr + ii
+        vi_ref[0] = ir - ri
+
+    spec_in = pl.BlockSpec(
+        (1, ft, nap, nframes), lambda c, f: (c, f, 0, 0)
+    )
+    spec_out = pl.BlockSpec((1, ft, nap, nap), lambda c, f: (c, f, 0, 0))
+
+    @jax.jit
+    def xengine(sr, si):
+        # (a, c, p, t, f) -> (c, f, ap, t), one XLA pass.
+        def pack(s):
+            nant = s.shape[0]
+            npol = s.shape[2]
+            return jnp.transpose(s, (1, 4, 0, 2, 3)).reshape(
+                nchan, nfft, nant * npol, nframes
+            )
+
+        ar, ai = pack(sr), pack(si)
+        return pl.pallas_call(
+            kernel,
+            grid=(nchan, nfft // ft),
+            in_specs=[spec_in, spec_in],
+            out_specs=[spec_out, spec_out],
+            out_shape=[
+                jax.ShapeDtypeStruct((nchan, nfft, nap, nap), jnp.float32),
+                jax.ShapeDtypeStruct((nchan, nfft, nap, nap), jnp.float32),
+            ],
+        )(ar, ai)
+
+    return xengine
+
+
+def main() -> int:
+    nant = int(sys.argv[1]) if len(sys.argv) > 1 else 64
+    nchan = int(sys.argv[2]) if len(sys.argv) > 2 else 16
+    nfft = int(sys.argv[3]) if len(sys.argv) > 3 else 512
+    nblk = int(sys.argv[4]) if len(sys.argv) > 4 else 64
+    rounds = int(sys.argv[5]) if len(sys.argv) > 5 else 3
+    reps = int(sys.argv[6]) if len(sys.argv) > 6 else 24
+    ft = int(sys.argv[7]) if len(sys.argv) > 7 else 8
+    ntap, npol = 4, 2
+    ntime = nblk * nfft
+    nframes = nblk - ntap + 1
+    nap = nant * npol
+
+    cache = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), ".jax_cache")
+    jax.config.update("jax_compilation_cache_dir", cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    from blit.ops.channelize import pfb_coeffs
+    from blit.parallel.correlator import _xengine_planar, f_engine_planar
+
+    rng = np.random.default_rng(0)
+    shape = (nant, nchan, npol, ntime)
+    vr = jnp.asarray(rng.standard_normal(shape).astype(np.float32))
+    vi = jnp.asarray(rng.standard_normal(shape).astype(np.float32))
+    hj = jnp.asarray(pfb_coeffs(ntap, nfft).astype(np.float32))
+    nbytes = vr.nbytes + vi.nbytes
+
+    xe_pl = make_xengine_pallas(nchan, nfft, nap, nframes, ft)
+
+    def make(xe):
+        @jax.jit
+        def f(a, b):
+            sr, si = f_engine_planar(a, b, hj)
+            visr, visi = xe(sr, si)
+            return jnp.sum(visr) + jnp.sum(visi)
+
+        return f
+
+    fa = make(_xengine_planar)
+    fb = make(xe_pl)
+    t0 = time.time()
+    ca, cb = float(fa(vr, vi)), float(fb(vr, vi))
+    rel = abs(cb - ca) / max(abs(ca), 1e-9)
+    print(f"warmup (incl. compile) {time.time() - t0:.1f}s "
+          f"checksum delta {rel:.2e}", flush=True)
+    # Both paths multiply at the TPU's default (bf16) matmul precision but
+    # reduce in different orders; interpret-mode element-wise equality is
+    # pinned separately, the chip checksum only guards gross breakage.
+    assert rel < 1e-3, "pallas X-engine disagrees with the einsum path"
+
+    def block(f):
+        t0 = time.time()
+        out = None
+        for _ in range(reps):
+            out = f(vr, vi)
+        float(out)
+        return reps * nbytes / (time.time() - t0) / 1e9
+
+    ga, gb = [], []
+    for r in range(rounds):
+        ga.append(block(fa))
+        gb.append(block(fb))
+        print(f"round {r}: A {ga[-1]:.2f}  B(pallas ft={ft}) {gb[-1]:.2f} "
+              "GB/s", flush=True)
+    print(f"A einsum:  {min(ga):.2f}-{max(ga):.2f} GB/s "
+          f"(median {np.median(ga):.2f})")
+    print(f"B pallas:  {min(gb):.2f}-{max(gb):.2f} GB/s "
+          f"(median {np.median(gb):.2f})")
+    print(f"median ratio B/A: {np.median(gb) / np.median(ga):.3f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
