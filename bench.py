@@ -185,6 +185,29 @@ def run_single(config_name: str) -> None:
     except Exception as e:  # noqa: BLE001 — secondary leg must not kill the line
         fqav_extra = {"fqav16_error": f"{type(e).__name__}: {e}"}
 
+    # Full-Stokes leg (VERDICT r4 item 5): the SAME config with
+    # stokes="IQUV" — nif=4, 4x the product bytes through the fused
+    # detect path.  Interleaved A/B measured 0.853x vs Stokes I at this
+    # config (17.8 vs 20.9 GB/s — DESIGN.md §9 r5 addendum); this leg
+    # keeps "every Stokes product" carrying a number.
+    try:
+        kwq = dict(kw, stokes="IQUV")
+
+        def stepq(x):
+            return jnp.sum(channelize(x, coeffs, **kwq))
+
+        float(stepq(vj))  # compile (persistent-cached)
+        t0 = time.perf_counter()
+        accq = [stepq(vj) for _ in range(K)]
+        float(accq[-1])
+        elq = time.perf_counter() - t0
+        del accq
+        fqav_extra["stokes_iquv_gbps"] = round(
+            net_bytes_per_call * K / elq / 1e9, 3
+        )
+    except Exception as e:  # noqa: BLE001 — secondary leg must not kill the line
+        fqav_extra["stokes_iquv_error"] = f"{type(e).__name__}: {e}"
+
     # Free the primary leg's device residents (up to GBs) before the
     # secondary legs — they have their own working sets and OOM otherwise.
     del vj
@@ -502,10 +525,22 @@ def _run_collectives() -> dict:
         el = time.perf_counter() - t0
         nbytes = cvp[0].nbytes + cvp[1].nbytes
         out["correlator64_gbps"] = round(nbytes * K64 / el / 1e9, 3)
+        # Provenance follows the ACTUAL dispatch (_xengine_packed's gate),
+        # not an assumption — a fallback must not record as "pallas".
+        from blit.ops.channelize import _MATMUL_ONLY_BACKENDS
+        from blit.ops.pallas_xengine import eligible as _xe_eligible
+
+        nframes = ntime // nfft - ntap + 1
+        xe = (
+            "pallas"
+            if jax.default_backend() in _MATMUL_ONLY_BACKENDS
+            and _xe_eligible(nant * npol, nfft, nframes)
+            else "einsum-packed"
+        )
         out["correlator64_config"] = {
             "nant": nant, "nchan": nchan, "nfft": nfft, "ntap": ntap,
             "ntime": ntime, "npol": npol, "input_bytes": nbytes,
-            "vis_layout": "packed", "x_engine": "pallas",
+            "vis_layout": "packed", "x_engine": xe,
             "source": "raw_files",
         }
         # bf16-staged (f32-equivalent bytes; measured +25% in the

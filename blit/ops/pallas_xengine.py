@@ -37,6 +37,7 @@ unit tests.
 from __future__ import annotations
 
 import functools
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -46,27 +47,55 @@ from blit.ops.dft import Planar
 
 FT_DEFAULT = 8
 
-# Scoped-VMEM budget for eligibility: block bytes double-buffer, and the
+# Scoped-VMEM model for eligibility: block bytes double-buffer, and the
 # compiler's scoped allocation runs ~1.6x the naive block arithmetic
 # (measured: ft=32 at nframes=61 is 12.4 MB naive but OOM'd at 19.8 MB
-# against the 16 MB limit).  10 MB naive keeps comfortably clear.
-_VMEM_BUDGET = 10 << 20
+# against the 16 MB limit).  The factor carries margin on top of the
+# measurement so admitted shapes sit clearly inside the limit.
+_VMEM_LIMIT = 16 << 20
+_SCOPED_FACTOR = 1.7
 
 
 def eligible(
-    nap: int, nfft: int, nframes: int, ft: int = FT_DEFAULT
+    nap: int,
+    nfft: int,
+    nframes: int,
+    ft: int = FT_DEFAULT,
+    itemsize: int = 4,
 ) -> bool:
     """Shapes where the kernel measured faster than the einsum X-engine
     AND fits scoped VMEM (long time segments grow the input blocks
     linearly with ``nframes`` — those fall back to the einsum path
-    instead of compile-failing, the channelize.py fits() convention)."""
-    blocks = 2 * (ft * nap * nframes) + 2 * (ft * nap * nap)  # f32 elems
+    instead of compile-failing, the channelize.py fits() convention).
+
+    ``itemsize`` is the SPECTRA element size: bf16-staged spectra halve
+    the input blocks, so longer segments stay eligible than with f32.
+    Outputs always accumulate f32.
+    """
+    in_bytes = 2 * (ft * nap * nframes) * itemsize
+    out_bytes = 2 * (ft * nap * nap) * 4
+    scoped = (in_bytes + out_bytes) * 2 * _SCOPED_FACTOR
     return (
         nap >= 128
         and nap % 8 == 0
         and nfft % ft == 0
-        and blocks * 4 * 2 <= _VMEM_BUDGET
+        and scoped <= _VMEM_LIMIT
     )
+
+
+def pick_ft(
+    nap: int, nfft: int, nframes: int, itemsize: int = 4
+) -> Optional[int]:
+    """Largest fine tile in {8, 4} that divides ``nfft`` and fits the
+    VMEM model, or None (→ einsum path).  ft=8 measured best at nap=128
+    (25.1 vs ft=16's 24.4 GB/s); larger nap or longer segments shrink
+    the tile one halving instead of falling off the kernel entirely.
+    Tiles below 4 are unmeasured territory — those shapes take the
+    einsum path rather than extrapolate."""
+    for ft in (FT_DEFAULT, 4):
+        if eligible(nap, nfft, nframes, ft=ft, itemsize=itemsize):
+            return ft
+    return None
 
 
 def _kernel(ar_ref, ai_ref, vr_ref, vi_ref):

@@ -95,11 +95,18 @@ def _xengine_planar(sr: jax.Array, si: jax.Array) -> Planar:
     concatenate materializes an extra copy of both spectra planes, and
     the MXU tiles were not the binding resource.
     """
+    return _xengine_einsums(sr, si, "abcfpq")
+
+
+def _xengine_einsums(sr: jax.Array, si: jax.Array, out: str) -> Planar:
+    """The four real cross-products as einsums, output layout chosen by
+    ``out`` subscripts ("abcfpq" standard / "cfapbq" packed) — one copy
+    of the rr/ii/ir/ri structure and the f32-accumulation pin."""
     kw = dict(preferred_element_type=jnp.float32)
-    rr = jnp.einsum("acptf,bcqtf->abcfpq", sr, sr, **kw)
-    ii = jnp.einsum("acptf,bcqtf->abcfpq", si, si, **kw)
-    ir = jnp.einsum("acptf,bcqtf->abcfpq", si, sr, **kw)
-    ri = jnp.einsum("acptf,bcqtf->abcfpq", sr, si, **kw)
+    rr = jnp.einsum(f"acptf,bcqtf->{out}", sr, sr, **kw)
+    ii = jnp.einsum(f"acptf,bcqtf->{out}", si, si, **kw)
+    ir = jnp.einsum(f"acptf,bcqtf->{out}", si, sr, **kw)
+    ri = jnp.einsum(f"acptf,bcqtf->{out}", sr, si, **kw)
     return rr + ii, ir - ri
 
 
@@ -117,19 +124,14 @@ def _xengine_packed(sr: jax.Array, si: jax.Array) -> Planar:
 
     nant, _c, npol = sr.shape[0], sr.shape[1], sr.shape[2]
     nap = nant * npol
-    if (
-        jax.default_backend() in _MATMUL_ONLY_BACKENDS
-        and pallas_xengine.eligible(nap, sr.shape[-1], sr.shape[3])
-    ):
-        vr, vi = pallas_xengine.xengine_packed(sr, si)
+    ft = pallas_xengine.pick_ft(
+        nap, sr.shape[-1], sr.shape[3], itemsize=sr.dtype.itemsize
+    )
+    if jax.default_backend() in _MATMUL_ONLY_BACKENDS and ft is not None:
+        vr, vi = pallas_xengine.xengine_packed(sr, si, ft=ft)
         shape6 = vr.shape[:2] + (nant, npol, nant, npol)
         return vr.reshape(shape6), vi.reshape(shape6)
-    kw = dict(preferred_element_type=jnp.float32)
-    rr = jnp.einsum("acptf,bcqtf->cfapbq", sr, sr, **kw)
-    ii = jnp.einsum("acptf,bcqtf->cfapbq", si, si, **kw)
-    ir = jnp.einsum("acptf,bcqtf->cfapbq", si, sr, **kw)
-    ri = jnp.einsum("acptf,bcqtf->cfapbq", sr, si, **kw)
-    return rr + ii, ir - ri
+    return _xengine_einsums(sr, si, "cfapbq")
 
 
 @functools.partial(

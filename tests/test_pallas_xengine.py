@@ -9,7 +9,11 @@ import pytest
 jax = pytest.importorskip("jax")
 import jax.numpy as jnp  # noqa: E402
 
-from blit.ops.pallas_xengine import eligible, xengine_packed  # noqa: E402
+from blit.ops.pallas_xengine import (  # noqa: E402
+    eligible,
+    pick_ft,
+    xengine_packed,
+)
 
 
 def golden_packed(sr, si):
@@ -50,13 +54,29 @@ class TestEligibility:
         # The production gate: pallas only where it measured faster
         # (nap >= 128); the nant=8 shape stays on the einsum path.
         assert eligible(128, 512, 61)
-        assert eligible(256, 512, 61)
         assert not eligible(16, 512, 61)       # nant=8 bench shape
         assert not eligible(128, 500, 61)      # fine tiles must divide
 
+    def test_pick_ft_adapts(self):
+        # The dispatcher shrinks the fine tile instead of falling off
+        # the kernel: nap=256's output blocks exceed the budget at ft=8.
+        assert pick_ft(128, 512, 61) == 8      # measured-best default
+        assert pick_ft(256, 512, 61) == 4      # shrinks, stays on kernel
+        assert pick_ft(128, 500, 61) == 4      # 500 = 4*125: ft=8 no, 4 yes
+        assert pick_ft(16, 512, 61) is None    # einsum path (nap small)
+        assert pick_ft(128, 509, 61) is None   # prime nfft: no tile divides
+
     def test_vmem_bound(self):
         # Long time segments grow the input blocks with nframes: those
-        # must fall back to the einsum path, not compile-fail (the
-        # measured OOM: ft=32-equivalent footprints past ~16 MB scoped).
-        assert eligible(128, 512, 512)
+        # must fall back to the einsum path, not compile-fail.  The
+        # budget applies the measured ~1.6x scoped-allocation factor
+        # WITH margin, so admitted shapes sit clearly inside the 16 MB
+        # limit (the naive-budget version admitted boundary shapes the
+        # factor pushes over).
+        assert eligible(128, 512, 256)
+        assert not eligible(128, 512, 512)
         assert not eligible(128, 512, 2045)
+        # bf16 spectra halve the input blocks: longer segments stay on
+        # the kernel exactly where the bf16-staged path runs.
+        assert eligible(128, 512, 512, itemsize=2)
+        assert not eligible(128, 512, 2045, itemsize=2)

@@ -46,6 +46,14 @@ def crash_after(n_slabs):
     return orig, crashing
 
 
+def test_cursor_sidecar_paths_in_lockstep():
+    # blit.io.fbh5 dodges a pipeline dependency by duplicating the
+    # sidecar naming rule; this pin keeps the two in lockstep.
+    from blit.io.fbh5 import _cursor_path
+
+    assert _cursor_path("/x/y.h5") == ReductionCursor.path_for("/x/y.h5")
+
+
 class TestWriterDurability:
     """ResumableFBH5Writer's own contract, driven directly."""
 
