@@ -456,6 +456,37 @@ def _run_collectives() -> dict:
         out["beamform_bf16_gbps"] = round(nbytes * K / el / 1e9, 3)
         del vp16
 
+        # Fused beamform+detect (round 5): packed chan-major bf16 planes
+        # from the SAME recordings through the VMEM-resident kernel
+        # (beamform(layout="chan") — beam planes never touch HBM;
+        # measured 2.1x the einsum path, DESIGN.md §9 r5 addendum).
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from blit.ops.pallas_beamform import pack_weights
+
+        _, vpc = A.load_antennas_mesh(paths, mesh=mesh, max_samples=ntime,
+                                      dtype="bfloat16", layout="chan")
+        kwr, kwi = pack_weights(jnp.asarray(np.asarray(wr)),
+                                jnp.asarray(np.asarray(wi)))
+        kwp = jax.device_put(
+            (np.asarray(kwr), np.asarray(kwi)),
+            NamedSharding(mesh, P(None, None, "bank")),
+        )
+        jax.block_until_ready((vpc, kwp))
+
+        def bstep_fused():
+            return jnp.sum(B.beamform(vpc, kwp, mesh=mesh, nint=nint,
+                                      layout="chan"))
+
+        float(bstep_fused())
+        float(bstep_fused())  # absorb the rig's one-off first-call alloc
+        t0 = time.perf_counter()
+        acc = [bstep_fused() for _ in range(K)]
+        float(acc[-1])
+        el = time.perf_counter() - t0
+        out["beamform_fused_gbps"] = round(nbytes * K / el / 1e9, 3)
+        del vpc
+
         # FX correlator: 8 antennas, PFB+DFT F-engine + full visibility matrix.
         nant, nchan, nfft, ntap, npol = 8, 64, 512, 4, 2
         ntime = 64 * nfft

@@ -116,6 +116,7 @@ def load_antennas_mesh(
     axis: str = "bank",
     max_samples: Optional[int] = None,
     dtype="float32",
+    layout: str = "antenna",
 ) -> Tuple[Dict, Planar]:
     """Load per-antenna RAW recordings onto the beamform layout:
     ``(nant, nchan, ntime, npol)`` planar voltages with the antenna axis
@@ -137,12 +138,20 @@ def load_antennas_mesh(
     (:func:`blit.parallel.beamform.beamform` runs its whole contraction
     in bf16 for bf16 inputs — measured +26% end-to-end, DESIGN.md §9 r5
     addendum).
+
+    ``layout``: ``"antenna"`` (above) or ``"chan"`` — packed chan-major
+    ``(nchan, nant, npol, ntime)`` planes for ``beamform(layout="chan")``
+    and its fused detect kernel (measured 2.1x; the pack happens in the
+    host copy this loader performs anyway, so it is free here, unlike a
+    device-side transpose).
     """
     import jax
 
     from blit.parallel.beamform import antenna_sharding
 
     dev_dtype = _resolve_plane_dtype(dtype)
+    if layout not in ("antenna", "chan"):
+        raise ValueError(f"bad layout {layout!r}")
 
     nant = len(raw_paths)
     ax_size = mesh.shape[axis]
@@ -151,14 +160,27 @@ def load_antennas_mesh(
             f"nant={nant} must divide over the {ax_size}-way {axis!r} axis"
         )
     per = nant // ax_size
-    sharding = antenna_sharding(mesh, axis)
+    if layout == "chan":
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sharding = NamedSharding(mesh, P(None, axis))
+    else:
+        sharding = antenna_sharding(mesh, axis)
 
     # The antenna blocks this process must place: one per addressable
-    # device, covering the antenna slice that device owns.
+    # device, covering the antenna slice that device owns — the device's
+    # mesh coordinate along `axis` (both layouts shard ONLY the antenna
+    # dim in equal blocks, so the block index IS that coordinate).
+    ax_i = list(mesh.axis_names).index(axis)
+
+    def ant_lo(d) -> int:
+        pos = np.argwhere(mesh.devices == d)[0]
+        return int(pos[ax_i]) * per
+
     local_ants = sorted({
         a
         for d in sharding.addressable_devices
-        for a in range(*_ant_range(sharding, d, nant))
+        for a in range(ant_lo(d), ant_lo(d) + per)
     })
     raws, min_samps, nchan, npol = _open_antennas(raw_paths, local_ants)
     ntime = min_samps if max_samples is None else min(min_samps, max_samples)
@@ -167,15 +189,27 @@ def load_antennas_mesh(
 
     shards_r, shards_i = [], []
     for d in sharding.addressable_devices:
-        lo, hi = _ant_range(sharding, d, nant)
-        br = np.empty((hi - lo, nchan, ntime, npol), np.float32)
-        bi = np.empty_like(br)
-        for j, a in enumerate(range(lo, hi)):
-            br[j], bi[j] = _planar_block(raws[a], 0, ntime)
+        lo = ant_lo(d)
+        if layout == "chan":
+            br = np.empty((nchan, per, npol, ntime), np.float32)
+            bi = np.empty_like(br)
+            for j, a in enumerate(range(lo, lo + per)):
+                pr, pi = _planar_block(raws[a], 0, ntime)  # (c, t, p)
+                br[:, j] = np.transpose(pr, (0, 2, 1))
+                bi[:, j] = np.transpose(pi, (0, 2, 1))
+        else:
+            br = np.empty((per, nchan, ntime, npol), np.float32)
+            bi = np.empty_like(br)
+            for j, a in enumerate(range(lo, lo + per)):
+                br[j], bi[j] = _planar_block(raws[a], 0, ntime)
         # int8-origin values are exact in bf16: the cast loses nothing.
         shards_r.append(jax.device_put(br.astype(dev_dtype, copy=False), d))
         shards_i.append(jax.device_put(bi.astype(dev_dtype, copy=False), d))
-    global_shape = (nant, nchan, ntime, npol)
+    global_shape = (
+        (nchan, nant, npol, ntime)
+        if layout == "chan"
+        else (nant, nchan, ntime, npol)
+    )
     vr = jax.make_array_from_single_device_arrays(
         global_shape, sharding, shards_r
     )
@@ -188,10 +222,6 @@ def load_antennas_mesh(
     return hdr, (vr, vi)
 
 
-def _ant_range(sharding, device, nant: int) -> Tuple[int, int]:
-    """The [lo, hi) antenna rows ``device`` owns under ``sharding``."""
-    idx = sharding.addressable_devices_indices_map((nant,))[device][0]
-    return idx.start or 0, idx.stop if idx.stop is not None else nant
 
 
 def load_correlator_mesh(

@@ -1,0 +1,138 @@
+"""Fused beamform+detect kernel (blit/ops/pallas_beamform.py), interpret
+mode, plus the packed chan-major ``beamform(layout="chan")`` path on the
+virtual mesh (einsum fallback there — the fused kernel needs the real
+backend AND a chip-local antenna axis; measured 2.1x, DESIGN.md §9 r5)."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from blit.ops.pallas_beamform import (  # noqa: E402
+    fused_beamform_detect,
+    pack_voltages,
+    pack_weights,
+    pick_tile,
+)
+from blit.parallel import beamform as B  # noqa: E402
+from blit.parallel.mesh import make_mesh  # noqa: E402
+
+
+def make_case(nant=4, nbeam=3, nchan=2, ntime=256, seed=0):
+    rng = np.random.default_rng(seed)
+    v = (rng.integers(-40, 41, (nant, nchan, ntime, 2))
+         + 1j * rng.integers(-40, 41, (nant, nchan, ntime, 2))
+         ).astype(np.complex64)
+    w = (rng.standard_normal((nbeam, nant, nchan))
+         + 1j * rng.standard_normal((nbeam, nant, nchan))
+         ).astype(np.complex64)
+    return v, w
+
+
+class TestFusedKernel:
+    @pytest.mark.parametrize("nint,tile", [(2, 64), (8, 128), (1, 32)])
+    def test_matches_numpy(self, nint, tile):
+        v, w = make_case(ntime=256)
+        kvr, kvi = pack_voltages(jnp.asarray(v.real), jnp.asarray(v.imag))
+        kwr, kwi = pack_weights(jnp.asarray(w.real), jnp.asarray(w.imag))
+        got = np.asarray(fused_beamform_detect(
+            kvr, kvi, kwr, kwi, nint=nint, tile=tile, interpret=True,
+        ))
+        want = B.beamform_np(v, w, nint=nint)  # (b, c, t_out, p)
+        np.testing.assert_allclose(
+            np.transpose(got, (1, 0, 3, 2)), want, rtol=1e-4,
+            atol=1e-3 * np.abs(want).max(),
+        )
+
+    def test_ineligible_shape_raises(self):
+        z = jnp.zeros((1, 4, 2, 100), jnp.float32)
+        w = jnp.zeros((1, 8, 4), jnp.float32)
+        with pytest.raises(ValueError, match="eligible"):
+            fused_beamform_detect(z, z, w, w, nint=8, interpret=True)
+
+    def test_explicit_tile_validated(self):
+        # An explicit tile that does not divide ntime would leave output
+        # tail blocks UNWRITTEN (silent garbage) — the guard must fire
+        # for caller-supplied tiles too, not just picked ones.
+        z = jnp.zeros((1, 4, 2, 300), jnp.float32)
+        w = jnp.zeros((1, 8, 4), jnp.float32)
+        with pytest.raises(ValueError, match="tile"):
+            fused_beamform_detect(z, z, w, w, nint=2, tile=256,
+                                  interpret=True)
+        with pytest.raises(ValueError, match="tile"):
+            fused_beamform_detect(z, z, w, w, nint=4, tile=150,
+                                  interpret=True)  # nint does not divide
+
+
+class TestPickTile:
+    def test_gate(self):
+        # Bench shape: tile = nint*128 divides ntime and fits.
+        assert pick_tile(64, 64, 2, 8192, 8) == 1024
+        assert pick_tile(64, 64, 2, 8192, 8, itemsize=2) == 1024
+        # ntime not divisible by nint*128 -> einsum path.
+        assert pick_tile(64, 64, 2, 1000, 8) is None
+        # nbeam must tile sublanes.
+        assert pick_tile(64, 63, 2, 8192, 8) is None
+
+
+class TestChanLayoutPath:
+    def test_matches_antenna_layout(self):
+        # The packed opt-in must compute the SAME product as the standard
+        # layout (einsum fallback on this CPU mesh), axes permuted.
+        v, w = make_case(nant=8, nbeam=5, nchan=4, ntime=64)
+        m = make_mesh(1, 8)
+        vp = jax.device_put(
+            (v.real.copy(), v.imag.copy()), B.antenna_sharding(m)
+        )
+        wp = jax.device_put((w.real.copy(), w.imag.copy()),
+                            B.weight_sharding(m))
+        std = np.asarray(B.beamform(vp, wp, mesh=m, nint=4))
+
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        kv = pack_voltages(jnp.asarray(v.real), jnp.asarray(v.imag))
+        kw = pack_weights(jnp.asarray(w.real), jnp.asarray(w.imag))
+        kvp = jax.device_put((np.asarray(kv[0]), np.asarray(kv[1])),
+                             NamedSharding(m, P(None, "bank")))
+        kwp = jax.device_put((np.asarray(kw[0]), np.asarray(kw[1])),
+                             NamedSharding(m, P(None, None, "bank")))
+        packed = np.asarray(B.beamform(kvp, kwp, mesh=m, nint=4,
+                                       layout="chan"))
+        assert packed.shape == (4, 5, 2, 16)  # (c, b, p, t_out)
+        np.testing.assert_allclose(
+            np.transpose(packed, (1, 0, 3, 2)), std, rtol=1e-4,
+            atol=1e-3 * np.abs(std).max(),
+        )
+
+    def test_loader_chan_layout(self, tmp_path):
+        from blit.parallel.antenna import load_antennas_mesh
+        from blit.testing import synth_raw
+
+        paths = []
+        for a in range(8):
+            p = str(tmp_path / f"a{a}.raw")
+            synth_raw(p, nblocks=1, obsnchan=2, ntime_per_block=64, seed=a)
+            paths.append(p)
+        m = make_mesh(1, 8)
+        hdr, (cr, ci) = load_antennas_mesh(paths, mesh=m, layout="chan")
+        _, (ar, ai) = load_antennas_mesh(paths, mesh=m)
+        assert cr.shape == (2, 8, 2, hdr["_ntime"])  # (c, a, p, t)
+        np.testing.assert_array_equal(
+            np.asarray(cr), np.transpose(np.asarray(ar), (1, 0, 3, 2))
+        )
+        np.testing.assert_array_equal(
+            np.asarray(ci), np.transpose(np.asarray(ai), (1, 0, 3, 2))
+        )
+        with pytest.raises(ValueError, match="layout"):
+            load_antennas_mesh(paths, mesh=m, layout="packed")
+
+    def test_bad_layout_rejected(self):
+        v, w = make_case(nant=8)
+        m = make_mesh(1, 8)
+        with pytest.raises(ValueError, match="layout"):
+            B.beamform(
+                jax.device_put(v, B.antenna_sharding(m)),
+                jax.device_put(w, B.weight_sharding(m)),
+                mesh=m, layout="fast",
+            )

@@ -132,6 +132,30 @@ def run():
                                rtol=2e-2, atol=2e-1)
     print("packed xengine: ok")
 
+    # Round 5: the fused beamform+detect kernel compiles NATIVELY and
+    # agrees (chip-local antenna axis + eligible tile -> pallas path).
+    from blit.ops.pallas_beamform import pack_voltages, pack_weights
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    bn, bb, bc, bt, bnint = 4, 8, 2, 256, 2  # tile = 2*128 divides 256
+    bv = (rng.standard_normal((bn, bc, bt, npol))
+          + 1j * rng.standard_normal((bn, bc, bt, npol))
+          ).astype(np.complex64)
+    bw = (rng.standard_normal((bb, bn, bc))
+          + 1j * rng.standard_normal((bb, bn, bc))).astype(np.complex64)
+    kv = pack_voltages(jnp.asarray(bv.real), jnp.asarray(bv.imag))
+    kw2 = pack_weights(jnp.asarray(bw.real), jnp.asarray(bw.imag))
+    kvp = jax.device_put((np.asarray(kv[0]), np.asarray(kv[1])),
+                         NamedSharding(mesh, P(None, "bank")))
+    kwp = jax.device_put((np.asarray(kw2[0]), np.asarray(kw2[1])),
+                         NamedSharding(mesh, P(None, None, "bank")))
+    fp = np.asarray(B.beamform(kvp, kwp, mesh=mesh, nint=bnint,
+                               layout="chan"))
+    wantf = B.beamform_np(bv, bw, nint=bnint)
+    np.testing.assert_allclose(np.transpose(fp, (1, 0, 3, 2)), wantf,
+                               rtol=2e-2, atol=2e-2 * np.abs(wantf).max())
+    print("fused beamform: ok")
+
     # Round 4: the file-fed antenna data plane end-to-end on the real
     # backend — per-antenna RAW files -> planar device shards -> beamform.
     import os as _os
@@ -245,5 +269,6 @@ def test_collectives_per_chip_math_runs_on_hardware():
     assert "beamform: ok" in proc.stdout
     assert "correlator: ok" in proc.stdout
     assert "packed xengine: ok" in proc.stdout
+    assert "fused beamform: ok" in proc.stdout
     assert "antenna loader: ok" in proc.stdout
     assert "pallas kernels: ok" in proc.stdout
