@@ -479,6 +479,12 @@ def _run_collectives() -> dict:
                                       layout="chan"))
 
         float(bstep_fused())
+        # The number is only honest if the pallas path dispatched: a
+        # silent einsum fallback must not masquerade as "fused".
+        assert B.last_beamform_plan().get("fused"), (
+            "fused beamform leg fell back to einsums: "
+            f"{B.last_beamform_plan()}"
+        )
         float(bstep_fused())  # absorb the rig's one-off first-call alloc
         t0 = time.perf_counter()
         acc = [bstep_fused() for _ in range(K)]

@@ -7,8 +7,17 @@ __all__ = ["fqav", "fqav_range", "kurtosis"]
 
 
 def __getattr__(name):
-    # Lazy: channelize/dft/despike pull in JAX; keep `import blit.ops` light.
-    if name in ("channelize", "dft", "despike"):
+    # Lazy: these pull in JAX; keep `import blit.ops` light.
+    if name in (
+        "channelize",
+        "dft",
+        "despike",
+        "pallas_pfb",
+        "pallas_dft",
+        "pallas_detect",
+        "pallas_xengine",
+        "pallas_beamform",
+    ):
         import importlib
 
         return importlib.import_module(f"blit.ops.{name}")

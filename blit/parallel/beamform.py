@@ -40,6 +40,19 @@ from blit.ops.dft import ComplexOrPlanar, Planar, as_planar
 
 ANT_AXIS_DEFAULT = "bank"
 
+# Dispatch resolution of the most recent beamform(layout="chan") TRACE
+# (the blit.ops.channelize._LAST_PLAN convention): silent fallbacks must
+# be attributable — the bench asserts the fused kernel actually ran
+# behind its beamform_fused_gbps number.
+_LAST_PLAN: dict = {}
+
+
+def last_beamform_plan() -> dict:
+    """The most recent chan-layout dispatch decision (``{"layout":
+    "chan", "fused": bool}``; empty until a trace happens — a jit cache
+    hit does not refresh it)."""
+    return dict(_LAST_PLAN)
+
 
 def delay_weights_planar(
     delays_s: jax.Array,
@@ -202,11 +215,18 @@ def _beamform_chan(
     from blit.ops import pallas_beamform as PB
     from blit.ops.channelize import _MATMUL_ONLY_BACKENDS
 
-    vr, vi, _ = as_planar(voltages)
-    wr, wi, _ = as_planar(weights)
+    vr, vi, v_cplx = as_planar(voltages)
+    wr, wi, w_cplx = as_planar(weights)
+    complex_out = v_cplx and w_cplx
     bf16 = vr.dtype == jnp.bfloat16
     nchan, nant, npol, ntime = vr.shape
     nbeam = wr.shape[1]
+    if detect and nint > 1 and ntime % nint:
+        # Same clear error as integrate() on the antenna path — the raw
+        # reshape below would fail with a cryptic trace-time message.
+        raise ValueError(
+            f"integrate: nint={nint} does not divide ntime={ntime}"
+        )
     fuse = (
         detect
         and mesh.shape[axis] == 1
@@ -214,6 +234,11 @@ def _beamform_chan(
         and PB.pick_tile(nant, nbeam, npol, ntime, nint,
                          itemsize=vr.dtype.itemsize) is not None
     )
+    # Dispatch provenance, the channelize _LAST_PLAN convention: the
+    # fuse/fallback decision is otherwise invisible, and the bench/smoke
+    # must be able to assert the pallas path actually ran.
+    _LAST_PLAN.clear()
+    _LAST_PLAN.update({"layout": "chan", "fused": fuse})
 
     def step(vr, vi, wr, wi):
         if bf16:
@@ -239,7 +264,7 @@ def _beamform_chan(
         return br, bi
 
     out_specs = P() if (detect or fuse) else (P(), P())
-    return jax.shard_map(
+    out = jax.shard_map(
         step,
         mesh=mesh,
         in_specs=(P(None, axis), P(None, axis), P(None, None, axis),
@@ -247,6 +272,12 @@ def _beamform_chan(
         out_specs=out_specs,
         check_vma=False,
     )(vr, vi, wr, wi)
+    if detect:
+        return out
+    br, bi = out
+    # Same complex-output contract as the antenna layout: complex64 when
+    # BOTH inputs were complex, else the planar pair.
+    return jax.lax.complex(br, bi) if complex_out else (br, bi)
 
 
 def antenna_sharding(mesh: Mesh, axis: str = ANT_AXIS_DEFAULT) -> NamedSharding:

@@ -127,6 +127,53 @@ class TestChanLayoutPath:
         with pytest.raises(ValueError, match="layout"):
             load_antennas_mesh(paths, mesh=m, layout="packed")
 
+    def test_detect_false_complex_contract(self):
+        # Same contract as the antenna layout: complex64 out when BOTH
+        # inputs were complex, planar pair otherwise.
+        v, w = make_case(nant=8, nbeam=5, nchan=4, ntime=64)
+        m = make_mesh(1, 8)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        kv = np.transpose(v, (1, 0, 3, 2)).copy()
+        kw = np.transpose(w, (2, 0, 1)).copy()
+        kvp = jax.device_put(kv, NamedSharding(m, P(None, "bank")))
+        kwp = jax.device_put(kw, NamedSharding(m, P(None, None, "bank")))
+        beams = B.beamform(kvp, kwp, mesh=m, detect=False, layout="chan")
+        assert beams.dtype == np.complex64
+        br, bi = B.beamform(
+            jax.device_put((kv.real.copy(), kv.imag.copy()),
+                           NamedSharding(m, P(None, "bank"))),
+            kwp, mesh=m, detect=False, layout="chan",
+        )
+        np.testing.assert_allclose(np.asarray(br), np.asarray(beams).real,
+                                   rtol=1e-4, atol=1e-2)
+
+    def test_nint_divisibility_checked(self):
+        v, w = make_case(nant=8, nbeam=5, nchan=4, ntime=64)
+        m = make_mesh(1, 8)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        kvp = jax.device_put(np.transpose(v, (1, 0, 3, 2)).copy(),
+                             NamedSharding(m, P(None, "bank")))
+        kwp = jax.device_put(np.transpose(w, (2, 0, 1)).copy(),
+                             NamedSharding(m, P(None, None, "bank")))
+        with pytest.raises(ValueError, match="does not divide"):
+            B.beamform(kvp, kwp, mesh=m, nint=7, layout="chan")
+
+    def test_dispatch_plan_recorded(self):
+        # The fuse/fallback decision is attributable (the channelize
+        # _LAST_PLAN convention); on this CPU mesh it must say fused=False.
+        v, w = make_case(nant=8, nbeam=5, nchan=4, ntime=64)
+        m = make_mesh(1, 8)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        kvp = jax.device_put(np.transpose(v, (1, 0, 3, 2)).copy(),
+                             NamedSharding(m, P(None, "bank")))
+        kwp = jax.device_put(np.transpose(w, (2, 0, 1)).copy(),
+                             NamedSharding(m, P(None, None, "bank")))
+        B.beamform(kvp, kwp, mesh=m, nint=4, layout="chan")
+        assert B.last_beamform_plan() == {"layout": "chan", "fused": False}
+
     def test_bad_layout_rejected(self):
         v, w = make_case(nant=8)
         m = make_mesh(1, 8)

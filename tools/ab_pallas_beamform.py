@@ -31,13 +31,11 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax.experimental import pallas as pl
-from jax.sharding import PartitionSpec as P
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def make_fused(nant, nbeam, nchan, ntime, nint, tile, dtype):
+def make_fused(nint, tile):
     """The SHIPPED kernel (blit/ops/pallas_beamform.py), not a prototype
     copy: re-running this tool keeps measuring what
     ``beamform(layout="chan")`` dispatches."""
@@ -103,7 +101,7 @@ def main() -> int:
         np.transpose(np.asarray(wi), (2, 0, 1))).astype(dtype))
     jax.block_until_ready((vp, wp, kvr, kvi, kwr, kwi))
 
-    fused = make_fused(nant, nbeam, nchan, ntime, nint, tile, dtype)
+    fused = make_fused(nint, tile)
 
     def fa():
         return jnp.sum(B.beamform(vp, wp, mesh=mesh, nint=nint))
