@@ -480,11 +480,14 @@ def _run_collectives() -> dict:
 
         float(bstep_fused())
         # The number is only honest if the pallas path dispatched: a
-        # silent einsum fallback must not masquerade as "fused".
-        assert B.last_beamform_plan().get("fused"), (
-            "fused beamform leg fell back to einsums: "
-            f"{B.last_beamform_plan()}"
-        )
+        # silent einsum fallback must not masquerade as "fused".  (An
+        # explicit raise — a bare assert would strip under python -O,
+        # exactly when nobody is watching.)
+        if not B.last_beamform_plan().get("fused"):
+            raise RuntimeError(
+                "fused beamform leg fell back to einsums: "
+                f"{B.last_beamform_plan()}"
+            )
         float(bstep_fused())  # absorb the rig's one-off first-call alloc
         t0 = time.perf_counter()
         acc = [bstep_fused() for _ in range(K)]

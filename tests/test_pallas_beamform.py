@@ -147,6 +147,8 @@ class TestChanLayoutPath:
         )
         np.testing.assert_allclose(np.asarray(br), np.asarray(beams).real,
                                    rtol=1e-4, atol=1e-2)
+        np.testing.assert_allclose(np.asarray(bi), np.asarray(beams).imag,
+                                   rtol=1e-4, atol=1e-2)
 
     def test_nint_divisibility_checked(self):
         v, w = make_case(nant=8, nbeam=5, nchan=4, ntime=64)

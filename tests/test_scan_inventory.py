@@ -36,12 +36,13 @@ def tree(tmp_path_factory):
     return root, invs
 
 
-def host_golden(invs, fqav_by=1):
+def host_golden(invs, fqav_by=1, stokes="I"):
     """Per-bank RawReducer over the same sequences, channel-concatenated."""
     _, _, grid = scan_grid(invs, SESSION, SCAN)
     banks = []
     for paths in grid[0]:
-        red = RawReducer(nfft=NFFT, nint=NINT, fqav_by=fqav_by)
+        red = RawReducer(nfft=NFFT, nint=NINT, fqav_by=fqav_by,
+                         stokes=stokes)
         _, d = red.reduce(paths)
         banks.append(d)
     return np.concatenate(banks, axis=-1)
@@ -281,6 +282,25 @@ class TestWindowEquivalenceFuzz:
             np.asarray(data), np.asarray(out)[0], rtol=1e-4, atol=0.5,
             err_msg=f"nint={nint} fqav={fqav} window_frames={wf}",
         )
+
+
+class TestFullStokesMeshProduct:
+    def test_iquv_product_matches_host(self, tree, tmp_path):
+        # Full polarimetry through the WHOLE mesh workflow: the nif=4
+        # product streams per band with nifs=4 headers, matching the
+        # host pipeline's IQUV reduction (the fused tail2_detect product
+        # generalization, bench leg stokes_iquv_gbps).
+        _, invs = tree
+        written = reduce_scan_mesh_to_files(
+            SESSION, SCAN, inventories=invs, out_dir=str(tmp_path),
+            nfft=NFFT, nint=NINT, stokes="IQUV", despike=False,
+            window_frames=4,
+        )
+        hdr, data = read_fil_data(written[0][0])
+        assert hdr["nifs"] == 4 and data.shape[1] == 4
+        want = host_golden(invs, stokes="IQUV")[: data.shape[0]]
+        np.testing.assert_allclose(np.asarray(data), want, rtol=1e-4,
+                                   atol=0.5)
 
 
 class TestBoundedDefaultWindow:

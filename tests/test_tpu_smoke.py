@@ -151,10 +151,11 @@ def run():
                          NamedSharding(mesh, P(None, None, "bank")))
     fp = np.asarray(B.beamform(kvp, kwp, mesh=mesh, nint=bnint,
                                layout="chan"))
-    assert B.last_beamform_plan().get("fused"), (
-        "chan-layout beamform fell back to einsums on the chip: "
-        f"{B.last_beamform_plan()}"
-    )
+    if not B.last_beamform_plan().get("fused"):  # survives python -O
+        raise AssertionError(
+            "chan-layout beamform fell back to einsums on the chip: "
+            f"{B.last_beamform_plan()}"
+        )
     wantf = B.beamform_np(bv, bw, nint=bnint)
     np.testing.assert_allclose(np.transpose(fp, (1, 0, 3, 2)), wantf,
                                rtol=2e-2, atol=2e-2 * np.abs(wantf).max())
