@@ -45,6 +45,26 @@ class TestFusedKernel:
             atol=1e-3 * np.abs(want).max(),
         )
 
+    def test_bf16_operands_accumulate_f32(self):
+        # The bench's fused leg feeds bf16-resident planes: the kernel's
+        # dots must accumulate f32 (preferred_element_type) and the
+        # integer-valued voltages stay exact through bf16.
+        v, w = make_case(ntime=256, seed=3)
+        kvr, kvi = pack_voltages(jnp.asarray(v.real), jnp.asarray(v.imag))
+        kwr, kwi = pack_weights(jnp.asarray(w.real), jnp.asarray(w.imag))
+        got = np.asarray(fused_beamform_detect(
+            kvr.astype(jnp.bfloat16), kvi.astype(jnp.bfloat16),
+            kwr.astype(jnp.bfloat16), kwi.astype(jnp.bfloat16),
+            nint=2, tile=64, interpret=True,
+        ))
+        assert got.dtype == np.float32
+        want = B.beamform_np(v, w, nint=2)
+        # bf16 weights round (voltages are int-exact): ~1e-2 relative.
+        np.testing.assert_allclose(
+            np.transpose(got, (1, 0, 3, 2)), want, rtol=3e-2,
+            atol=3e-2 * np.abs(want).max(),
+        )
+
     def test_ineligible_shape_raises(self):
         z = jnp.zeros((1, 4, 2, 100), jnp.float32)
         w = jnp.zeros((1, 8, 4), jnp.float32)
