@@ -82,6 +82,7 @@ def _cmd_scan(args: argparse.Namespace) -> int:
         max_frames=args.max_frames,
         compression=args.compression,
         resume=args.resume,
+        dtype=args.dtype,
         timeline=tl,
         trace_logdir=args.trace_logdir,
     )
@@ -195,6 +196,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     ps.add_argument("--fqav", type=int, default=1,
                     help="per-chip frequency averaging before the stitch")
     ps.add_argument("--no-despike", action="store_true")
+    ps.add_argument("--dtype", default="float32",
+                    choices=["float32", "bfloat16"],
+                    help="per-chip channelizer stage dtype (bfloat16 = "
+                         "the official bench's lever; product stays f32)")
     ps.add_argument("--window-frames", type=int, default=None,
                     help="PFB frames per device window (bounds HBM, host "
                          "RSS, and per-window readback).  Default: "

@@ -71,7 +71,7 @@ def filterbank_sharding(mesh: Mesh, stitched: bool) -> NamedSharding:
     jax.jit,
     static_argnames=(
         "mesh", "nfft", "ntap", "nint", "stokes", "fft_method", "stitch",
-        "despike_nfpc", "fqav_by",
+        "despike_nfpc", "fqav_by", "dtype",
     ),
 )
 def band_reduce(
@@ -87,6 +87,7 @@ def band_reduce(
     stitch: bool = True,
     despike_nfpc: int = 0,
     fqav_by: int = 1,
+    dtype: str = "float32",
 ) -> jax.Array:
     """The full multi-chip reduction step: every chip channelizes its own
     bank's voltage block, then the 8 banks of each band stitch their fine
@@ -107,6 +108,11 @@ def band_reduce(
         BEFORE the stitch collective — the reference's reduce-before-the-
         wire lever (src/gbtworkerfunctions.jl:16-20) mapped onto ICI: the
         all_gather moves ``fqav_by``x fewer bytes.
+      dtype: working dtype of the per-chip channelizer stages ("float32"
+        | "bfloat16") — the single-chip pipeline's biggest measured lever
+        (DESIGN.md §3: bf16 stages halve the HBM intermediates and run
+        the official bench), now reachable from the mesh path too.  The
+        product stays float32 either way.
 
     Returns:
       float32 ``(nband, ntime_out, nif, nchans)`` where ``nchans`` is the
@@ -124,7 +130,7 @@ def band_reduce(
         # v: (1, 1, nchan, ntime, npol, 2) — this chip's block.
         out = channelize(
             v[0, 0], h, nfft=nfft, ntap=ntap, nint=nint, stokes=stokes,
-            fft_method=fft_method, fqav_by=fqav_by,
+            fft_method=fft_method, fqav_by=fqav_by, dtype=dtype,
         )  # (t, nif, nchan*nfft//fqav_by)
         if stitch:
             out = jax.lax.all_gather(out, BANK_AXIS, axis=2, tiled=True)

@@ -325,6 +325,7 @@ def load_scan_mesh(
     despike: bool = True,
     max_frames: Optional[int] = None,
     mesh=None,
+    dtype: str = "float32",
 ) -> Tuple[Dict, "object"]:
     """Reduce one scan's RAW files across the mesh and stitch each band.
 
@@ -398,6 +399,7 @@ def load_scan_mesh(
         stitch=True,
         despike_nfpc=_despike_nfpc(despike, nfft, fqav_by),
         fqav_by=fqav_by,
+        dtype=dtype,
     )
 
     h0, bases, per_bank = _scan_headers(
@@ -431,6 +433,7 @@ def reduce_scan_mesh_to_files(
     compression: Optional[str] = None,
     resume: bool = False,
     mesh=None,
+    dtype: str = "float32",
     timeline=None,
     trace_logdir: Optional[str] = None,
 ) -> Dict[int, Tuple[str, Dict]]:
@@ -450,6 +453,11 @@ def reduce_scan_mesh_to_files(
 
     Call shapes and reduction parameters match :func:`load_scan_mesh`
     (explicit grid or ``(session, scan, inventories=...)``).
+
+    ``dtype`` selects the per-chip channelizer stage dtype ("float32" |
+    "bfloat16" — the official bench's biggest lever, DESIGN.md §3; the
+    products stay float32 and dtype joins the resume identity since
+    bf16 stages round differently).
 
     Observability (SURVEY.md §5 metrics bar): pass ``timeline`` (a
     :class:`blit.observability.Timeline`) to accumulate per-window stage
@@ -591,9 +599,11 @@ def reduce_scan_mesh_to_files(
             base = default_chunks(nif, nchans, 4, whole_spectrum=True)[0]
             h5_chunk_rows = math.gcd(base, wrows)
             wrows_ident = wrows
+        # dtype is output-affecting (bf16 stages round differently), so
+        # it joins the resume identity like every other config knob.
         ident = SimpleNamespace(
             nfft=nfft, ntap=ntap, nint=nint, stokes=stokes, window=window,
-            fqav_by=fqav_by, dtype="float32", despike_nfpc=despike_nfpc,
+            fqav_by=fqav_by, dtype=dtype, despike_nfpc=despike_nfpc,
         )
         # This process's fed member files: the input identity a resume
         # must match (a changed recording would splice different spectra).
@@ -617,8 +627,8 @@ def reduce_scan_mesh_to_files(
                 cur = ReductionCursor(
                     members, nfft, ntap, nint, stokes, 0, window=window,
                     raw_size=size, raw_mtime_ns=mtime_ns, fqav_by=fqav_by,
-                    despike_nfpc=despike_nfpc, compression=comp_id,
-                    window_rows=wrows_ident,
+                    dtype=dtype, despike_nfpc=despike_nfpc,
+                    compression=comp_id, window_rows=wrows_ident,
                 )
             cursors[b] = cur
             local_done.append(cur.frames_done if ok else 0)
@@ -709,6 +719,7 @@ def reduce_scan_mesh_to_files(
                         stitch=True,
                         despike_nfpc=despike_nfpc,
                         fqav_by=fqav_by,
+                        dtype=dtype,
                     )
                 if pending is not None:
                     flush(pending)

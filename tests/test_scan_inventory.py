@@ -284,6 +284,73 @@ class TestWindowEquivalenceFuzz:
         )
 
 
+class TestBf16StagesMeshProduct:
+    def test_bf16_stages_match_f32_within_rounding(self, tree, tmp_path):
+        # The single-chip pipeline's biggest measured lever (DESIGN §3)
+        # reaches the mesh path: dtype="bfloat16" runs the per-chip
+        # channelizer stages half-width; the product stays float32 and
+        # matches the f32 reduction within bf16 rounding.
+        _, invs = tree
+        f32_dir, bf_dir = tmp_path / "f32", tmp_path / "bf16"
+        f32_dir.mkdir(), bf_dir.mkdir()
+        reduce_scan_mesh_to_files(
+            SESSION, SCAN, inventories=invs, out_dir=str(f32_dir),
+            nfft=NFFT, nint=NINT, window_frames=4,
+        )
+        written = reduce_scan_mesh_to_files(
+            SESSION, SCAN, inventories=invs, out_dir=str(bf_dir),
+            nfft=NFFT, nint=NINT, window_frames=4, dtype="bfloat16",
+        )
+        _, a = read_fil_data(str(f32_dir / "band0.fil"))
+        hdr, b = read_fil_data(written[0][0])
+        assert np.asarray(b).dtype == np.float32
+        scale = float(np.abs(np.asarray(a)).max())
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=2e-2, atol=2e-2 * scale)
+
+    def test_dtype_flip_restarts_resume_fresh(self, tree, tmp_path,
+                                              monkeypatch):
+        # dtype is output-affecting: a resume under the other dtype must
+        # restart fresh (cursor identity), not splice mixed-rounding
+        # spectra.
+        from blit.parallel import mesh as M
+
+        _, invs = tree
+        real = M.band_reduce
+        calls = []
+
+        def flaky(*a, **kw):
+            calls.append(1)
+            # Call 3: one window is already FLUSHED (the loop keeps one
+            # window in flight, so the first append happens after the
+            # 2nd dispatch) — the cursor genuinely claims progress and
+            # the dtype-flipped resume must DISCARD it, not splice.
+            if len(calls) == 3:
+                raise RuntimeError("boom")
+            return real(*a, **kw)
+
+        monkeypatch.setattr(M, "band_reduce", flaky)
+        with pytest.raises(RuntimeError):
+            reduce_scan_mesh_to_files(
+                SESSION, SCAN, inventories=invs, out_dir=str(tmp_path),
+                nfft=NFFT, nint=NINT, window_frames=4, resume=True,
+                despike=False,
+            )
+        _, partial = read_fil_data(str(tmp_path / "band0.fil"), mmap=False)
+        assert partial.shape[0] > 0  # the identity guard has work to undo
+        monkeypatch.setattr(M, "band_reduce", real)
+        reduce_scan_mesh_to_files(
+            SESSION, SCAN, inventories=invs, out_dir=str(tmp_path),
+            nfft=NFFT, nint=NINT, window_frames=4, resume=True,
+            dtype="bfloat16", despike=False,
+        )
+        _, data = read_fil_data(str(tmp_path / "band0.fil"))
+        want = host_golden(invs)[: data.shape[0]]
+        scale = float(np.abs(want).max())
+        np.testing.assert_allclose(np.asarray(data), want, rtol=2e-2,
+                                   atol=2e-2 * scale)
+
+
 class TestFullStokesMeshProduct:
     def test_iquv_product_matches_host(self, tree, tmp_path):
         # Full polarimetry through the WHOLE mesh workflow: the nif=4
