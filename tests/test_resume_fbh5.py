@@ -251,6 +251,66 @@ class TestReduceResumableH5:
         np.testing.assert_array_equal(read_fbh5_data(out), want)
 
 
+class TestSigkillResume:
+    def test_sigkill_mid_reduction_resumes_identically(self, tmp_path):
+        # The real crash, not an injected exception: a subprocess running
+        # the bitshuffle .h5 reduction is SIGKILLed once its cursor
+        # claims progress (no cleanup, no atexit — the durability
+        # ordering alone must leave a resumable prefix).  The resumed
+        # product must equal an uninterrupted run bit-for-bit (decoded).
+        import json
+        import signal
+        import subprocess
+        import sys
+        import time
+
+        pytest.importorskip("blit.io.bshuf").available() or pytest.skip(
+            "native codec unbuilt")
+        raw = str(tmp_path / "x.raw")
+        synth_raw(raw, nblocks=6, obsnchan=2, ntime_per_block=2048,
+                  tone_chan=1)
+        out = str(tmp_path / "x.h5")
+        # chunk_frames=2: ~90 fsync'd cursor updates per run — a wide
+        # window for the 2 ms poll to land the kill mid-run.
+        child = (
+            "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+            "from blit.pipeline import RawReducer\n"
+            "RawReducer(nfft=64, nint=2, chunk_frames=2).reduce_resumable("
+            f"{raw!r}, {out!r}, compression='bitshuffle', "
+            "chunks=(1, 1, 128))\n"
+        )
+        env = {**os.environ, "PYTHONPATH": ""}  # keep the axon plugin out
+        p = subprocess.Popen([sys.executable, "-c", child], env=env,
+                             stderr=subprocess.PIPE, text=True)
+        deadline = time.time() + 120
+        killed = False
+        cursor = ReductionCursor.path_for(out)
+        while time.time() < deadline and p.poll() is None:
+            try:
+                if json.load(open(cursor))["frames_done"] > 0:
+                    p.send_signal(signal.SIGKILL)
+                    killed = True
+                    break
+            except (OSError, ValueError):
+                pass
+            time.sleep(0.002)
+        if p.poll() is None and not killed:
+            p.kill()  # deadline expired with a hung child: don't leak it
+        _, err = p.communicate(timeout=60)
+        if not killed:
+            # Startup crash vs genuinely-too-fast must be distinguishable.
+            pytest.fail(
+                f"child was not killed mid-run (rc={p.returncode}); "
+                f"stderr:\n{(err or '')[-2000:]}"
+            )
+        assert os.path.exists(out) and os.path.exists(cursor)
+        make_red().reduce_resumable(raw, out, compression="bitshuffle",
+                                    chunks=(1, 1, 128))
+        _, want = make_red().reduce(raw)
+        np.testing.assert_array_equal(read_fbh5_data(out), want)
+        assert not os.path.exists(cursor)
+
+
 class TestCLI:
     def test_reduce_resume_h5_bitshuffle(self, tmp_path, raw, capsys):
         import json
