@@ -357,12 +357,17 @@ def _run_collectives() -> dict:
     Reported as GB/s of planar antenna voltages consumed.
 
     The inputs are REAL per-antenna GUPPI RAW files on a ram-backed dir,
-    loaded through the file-fed antenna data plane
-    (blit/parallel/antenna.py) — the collective legs consume the same
-    bytes a recording would provide, not rng arrays (VERDICT r3 item 4).
-    The load is timed separately (``rig_*_load_s`` — "rig_" because on
-    this 1-core tunneled rig the host+transfer leg is environment-bound);
-    the chip numbers are the headline.
+    loaded through the WINDOWED antenna data plane
+    (blit/parallel/antenna.py streams — the collective legs consume the
+    same bytes a recording would provide, not rng arrays; VERDICT r3
+    item 4).  Device residents for the K-dispatch chip numbers come from
+    a one-window feed; the ``*_stream_*`` legs then run genuinely
+    multi-window (ingest/pack/transfer overlapping compute at
+    ``prefetch_depth`` windows of host memory — recording length no
+    longer bounds host RSS) and report per-window stage timings with
+    byte counts (``rig_*_feed`` — "rig_" because on this 1-core tunneled
+    rig the host+transfer legs are environment-bound); the chip numbers
+    are the headline.
     """
     import os
     import shutil
@@ -371,6 +376,7 @@ def _run_collectives() -> dict:
     import jax
     import jax.numpy as jnp
 
+    from blit.observability import Timeline
     from blit.ops.channelize import pfb_coeffs
     from blit.parallel import antenna as A
     from blit.parallel import beamform as B
@@ -381,6 +387,22 @@ def _run_collectives() -> dict:
     mesh = M.make_mesh(1, 1)
     rng = np.random.default_rng(3)
     out = {}
+
+    def stage_table(tl: Timeline) -> dict:
+        """ONE serializer for every collective stage table (s/bytes per
+        stage + the byte_free marker, so each report can be checked
+        against the nonzero-seconds ⇒ nonzero-bytes-or-byte-free
+        invariant).  list(): feed producer threads may still be
+        inserting stage keys."""
+        return {
+            k: {"s": round(v.seconds, 3), "bytes": v.bytes,
+                **({"byte_free": True} if v.byte_free else {})}
+            for k, v in sorted(list(tl.stages.items()))
+        }
+
+    def feed_report(tl: Timeline, seconds: float) -> dict:
+        """A feed Timeline as the JSON report block."""
+        return {"seconds": round(seconds, 3), "stages": stage_table(tl)}
 
     tmp = tempfile.mkdtemp(
         dir="/dev/shm" if os.path.isdir("/dev/shm") else None
@@ -399,14 +421,24 @@ def _run_collectives() -> dict:
 
         # Beamform: 64 antennas -> 64 beams, detect+integrate.
         nant, nbeam, nchan, ntime, npol, nint = 64, 64, 64, 8192, 2, 8
-        # Fixture synthesis happens OUTSIDE the timed load window — *_load_s
-        # measures the antenna data plane (file read + dequant + device_put),
-        # not rng writes a real recording never incurs.
+        # Fixture synthesis happens OUTSIDE the timed load window — the
+        # feed legs measure the antenna data plane (file read + dequant +
+        # device_put), not rng writes a real recording never incurs.
         paths = ant_files("bf", nant, nchan, ntime)
+        # Device residents via a ONE-WINDOW feed (the windowed data plane
+        # is the only load path now); the window stays unreleased for the
+        # whole K-loop — its arrays may alias the slot's host buffers.
+        tl_bf = Timeline()
         t0 = time.perf_counter()
-        hdr, vp = A.load_antennas_mesh(paths, mesh=mesh, max_samples=ntime)
-        jax.block_until_ready(vp)
-        out["rig_beamform_load_s"] = round(time.perf_counter() - t0, 3)
+        bf_wins = list(A.AntennaStream(
+            paths, mesh=mesh, window_samples=ntime, max_samples=ntime,
+            timeline=tl_bf,
+        ))
+        jax.block_until_ready(bf_wins[0].arrays)
+        out["rig_beamform_feed"] = feed_report(
+            tl_bf, time.perf_counter() - t0
+        )
+        vp = bf_wins[0].arrays
         wr, wi = B.delay_weights_planar(
             jnp.asarray(rng.uniform(0, 1e-9, (nbeam, nant))),
             jnp.asarray(np.linspace(1e9, 1.1e9, nchan)),
@@ -464,8 +496,11 @@ def _run_collectives() -> dict:
 
         from blit.ops.pallas_beamform import pack_weights
 
-        _, vpc = A.load_antennas_mesh(paths, mesh=mesh, max_samples=ntime,
-                                      dtype="bfloat16", layout="chan")
+        chan_wins = list(A.AntennaStream(
+            paths, mesh=mesh, window_samples=ntime, max_samples=ntime,
+            dtype="bfloat16", layout="chan",
+        ))
+        vpc = chan_wins[0].arrays
         kwr, kwi = pack_weights(jnp.asarray(np.asarray(wr)),
                                 jnp.asarray(np.asarray(wi)))
         kwp = jax.device_put(
@@ -480,32 +515,79 @@ def _run_collectives() -> dict:
 
         float(bstep_fused())
         # The number is only honest if the pallas path dispatched: a
-        # silent einsum fallback must not masquerade as "fused".  (An
-        # explicit raise — a bare assert would strip under python -O,
-        # exactly when nobody is watching.)
-        if not B.last_beamform_plan().get("fused"):
-            raise RuntimeError(
-                "fused beamform leg fell back to einsums: "
-                f"{B.last_beamform_plan()}"
+        # silent einsum fallback must not masquerade as "fused" — record
+        # the fallback as an explicit error field and skip the number
+        # (NOT a raise: that used to kill every later collective leg on
+        # rigs whose backend can't fuse, exactly where the windowed
+        # stream legs below still carry signal).
+        if B.last_beamform_plan().get("fused"):
+            float(bstep_fused())  # absorb the rig's one-off first-call alloc
+            t0 = time.perf_counter()
+            acc = [bstep_fused() for _ in range(K)]
+            float(acc[-1])
+            el = time.perf_counter() - t0
+            out["beamform_fused_gbps"] = round(nbytes * K / el / 1e9, 3)
+        else:
+            out["beamform_fused_error"] = (
+                f"fell back to einsums: {B.last_beamform_plan()}"
             )
-        float(bstep_fused())  # absorb the rig's one-off first-call alloc
-        t0 = time.perf_counter()
-        acc = [bstep_fused() for _ in range(K)]
-        float(acc[-1])
-        el = time.perf_counter() - t0
-        out["beamform_fused_gbps"] = round(nbytes * K / el / 1e9, 3)
         del vpc
+        for w_ in chan_wins:
+            w_.release()
+        del chan_wins
+
+        # WINDOWED streaming beamform leg: the same recordings through a
+        # genuinely multi-window feed + beamform_stream — end-to-end
+        # file→beam-power at prefetch_depth-bounded host memory, with
+        # per-window stage timings (the mesh analog of rig_ingest_gbps;
+        # acceptance: ingest/transfer/compute each carry bytes or are
+        # declared byte-free).
+        tl_s = Timeline()
+        wsamp = ntime // 4
+        feed = A.AntennaStream(
+            paths, mesh=mesh, window_samples=wsamp, max_samples=ntime,
+            timeline=tl_s,
+        )
+        per_window = []
+        snap = tl_s.snapshot()
+        t0 = time.perf_counter()
+        for _slab in B.beamform_stream(feed, wp, mesh=mesh, nint=nint,
+                                       timeline=tl_s):
+            if len(per_window) < 3:
+                per_window.append(tl_s.since(snap))
+            snap = tl_s.snapshot()
+        el = time.perf_counter() - t0
+        fed = nant * nchan * ntime * npol * 2  # int8 RAW bytes consumed
+        out["rig_beamform_stream_gbps"] = round(fed / el / 1e9, 3)
+        out["rig_beamform_stream"] = {
+            "windows": feed.nwindows,
+            "window_samples": wsamp,
+            "prefetch_depth": feed.prefetch_depth,
+            "seconds": round(el, 3),
+            "stages": stage_table(tl_s),
+            "per_window": per_window,
+        }
+        del vp
+        for w_ in bf_wins:
+            w_.release()
+        del bf_wins
 
         # FX correlator: 8 antennas, PFB+DFT F-engine + full visibility matrix.
         nant, nchan, nfft, ntap, npol = 8, 64, 512, 4, 2
         ntime = 64 * nfft
         paths = ant_files("fx", nant, nchan, ntime)
+        tl_fx = Timeline()
         t0 = time.perf_counter()
-        _chdr, cvp = A.load_correlator_mesh(
-            paths, mesh=mesh, nfft=nfft, ntap=ntap, max_samples=ntime,
+        fx_wins = list(A.CorrelatorStream(
+            paths, mesh=mesh, nfft=nfft, ntap=ntap,
+            window_frames=ntime // nfft - ntap + 1, max_samples=ntime,
+            timeline=tl_fx,
+        ))
+        jax.block_until_ready(fx_wins[0].arrays)
+        out["rig_correlator_feed"] = feed_report(
+            tl_fx, time.perf_counter() - t0
         )
-        jax.block_until_ready(cvp)
-        out["rig_correlator_load_s"] = round(time.perf_counter() - t0, 3)
+        cvp = fx_wins[0].arrays
         h = jnp.asarray(pfb_coeffs(ntap, nfft))
 
         def cstep():
@@ -524,6 +606,10 @@ def _run_collectives() -> dict:
             "ntime": ntime, "npol": npol, "input_bytes": nbytes,
             "source": "raw_files",
         }
+        del cvp
+        for w_ in fx_wins:
+            w_.release()
+        del fx_wins
 
         # FX correlator at ARRAY SCALE (VERDICT r4 item 1): 64 antennas —
         # (nant*npol)^2 = 128^2 baseline tiles, exactly MXU-sized — through
@@ -536,12 +622,18 @@ def _run_collectives() -> dict:
         h = jnp.asarray(pfb_coeffs(ntap, nfft))  # local: don't lean on the
         # nant=8 section happening to share (ntap, nfft)
         paths = ant_files("fx64", nant, nchan, ntime)
+        tl_fx64 = Timeline()
         t0 = time.perf_counter()
-        _chdr, cvp = A.load_correlator_mesh(
-            paths, mesh=mesh, nfft=nfft, ntap=ntap, max_samples=ntime,
+        fx64_wins = list(A.CorrelatorStream(
+            paths, mesh=mesh, nfft=nfft, ntap=ntap,
+            window_frames=ntime // nfft - ntap + 1, max_samples=ntime,
+            timeline=tl_fx64,
+        ))
+        jax.block_until_ready(fx64_wins[0].arrays)
+        out["rig_correlator64_feed"] = feed_report(
+            tl_fx64, time.perf_counter() - t0
         )
-        jax.block_until_ready(cvp)
-        out["rig_correlator64_load_s"] = round(time.perf_counter() - t0, 3)
+        cvp = fx64_wins[0].arrays
 
         cvp16 = jax.tree.map(lambda a: a.astype(jnp.bfloat16), cvp)
         jax.block_until_ready(cvp16)
@@ -557,6 +649,16 @@ def _run_collectives() -> dict:
             return jnp.sum(visr) + jnp.sum(visi)
 
         float(c64step())
+        # Provenance follows the ACTUAL dispatch: _xengine_packed records
+        # its trace-time gate decision (last_xengine_plan, the
+        # last_beamform_plan convention) — the gate runs on per-shard
+        # LOCAL shapes, so re-deriving it here from global shapes would
+        # drift (ADVICE r5 low).  Read it right after the f32 warmup
+        # trace (the bf16 warmup below re-traces with itemsize 2).
+        plan = C.last_xengine_plan()
+        xe = (
+            "pallas" if plan.get("engine") == "pallas" else "einsum-packed"
+        )
         float(c64step16())
         K64 = 24  # ~21 ms/call: K*c >= 400 ms amortizes the closing fetch
         t0 = time.perf_counter()
@@ -565,18 +667,6 @@ def _run_collectives() -> dict:
         el = time.perf_counter() - t0
         nbytes = cvp[0].nbytes + cvp[1].nbytes
         out["correlator64_gbps"] = round(nbytes * K64 / el / 1e9, 3)
-        # Provenance follows the ACTUAL dispatch (_xengine_packed's gate),
-        # not an assumption — a fallback must not record as "pallas".
-        from blit.ops.channelize import _MATMUL_ONLY_BACKENDS
-        from blit.ops.pallas_xengine import eligible as _xe_eligible
-
-        nframes = ntime // nfft - ntap + 1
-        xe = (
-            "pallas"
-            if jax.default_backend() in _MATMUL_ONLY_BACKENDS
-            and _xe_eligible(nant * npol, nfft, nframes)
-            else "einsum-packed"
-        )
         out["correlator64_config"] = {
             "nant": nant, "nchan": nchan, "nfft": nfft, "ntap": ntap,
             "ntime": ntime, "npol": npol, "input_bytes": nbytes,
@@ -590,6 +680,55 @@ def _run_collectives() -> dict:
         float(acc[-1])
         el = time.perf_counter() - t0
         out["correlator64_bf16_gbps"] = round(nbytes * K64 / el / 1e9, 3)
+        del cvp, cvp16
+        for w_ in fx64_wins:
+            w_.release()
+        del fx64_wins
+
+        # WINDOWED streaming correlator leg: the nant=8 recordings through
+        # a multi-window CorrelatorStream + correlate_stream — file→
+        # integrated visibilities with the PFB tail carried between
+        # windows and the accumulator folded on-device, at
+        # prefetch_depth-bounded host memory.
+        nant, nchan, nfft, ntap, npol = 8, 64, 512, 4, 2
+        ntime = 64 * nfft
+        h = jnp.asarray(pfb_coeffs(ntap, nfft))
+        paths = ant_files("fxs", nant, nchan, ntime)
+        tl_cs = Timeline()
+        wf = (ntime // nfft - ntap + 1) // 4  # 4 windows + remainder
+        feed = A.CorrelatorStream(
+            paths, mesh=mesh, nfft=nfft, ntap=ntap, window_frames=wf,
+            max_samples=ntime, timeline=tl_cs,
+        )
+        per_window = []
+        snap = tl_cs.snapshot()
+        t0 = time.perf_counter()
+
+        def _fx_windows():
+            nonlocal snap
+            for win in feed:
+                if len(per_window) < 3:
+                    per_window.append(tl_cs.since(snap))
+                snap = tl_cs.snapshot()
+                yield win
+
+        visr, visi = C.correlate_stream(
+            _fx_windows(), h, mesh=mesh, nfft=nfft, ntap=ntap,
+            timeline=tl_cs,
+        )
+        checksum = float(jnp.sum(visr) + jnp.sum(visi))
+        el = time.perf_counter() - t0
+        fed = nant * nchan * feed.seg * feed.nband * npol * 2
+        out["rig_correlator_stream_gbps"] = round(fed / el / 1e9, 3)
+        out["rig_correlator_stream"] = {
+            "windows": feed.nwindows,
+            "window_frames": wf,
+            "prefetch_depth": feed.prefetch_depth,
+            "seconds": round(el, 3),
+            "checksum": checksum,
+            "stages": stage_table(tl_cs),
+            "per_window": per_window,
+        }
         return out
     finally:
         # RAM-backed fixtures must not outlive the run, success or

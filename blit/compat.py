@@ -1,0 +1,30 @@
+"""Version-bridging JAX shims.
+
+The collective data plane targets current JAX (``jax.shard_map`` with
+``check_vma``), but deployment rigs pin older releases where the API
+still lives at ``jax.experimental.shard_map.shard_map`` with the
+``check_rep`` spelling.  Every blit call site goes through this one
+bridge so the version split lives in exactly one place.
+"""
+
+from __future__ import annotations
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` across JAX versions (keyword form only — the
+    blit call convention).  ``check_vma`` maps onto the old API's
+    ``check_rep`` (same meaning: static per-axis invariance checking,
+    disabled where psum/all_gather outputs defeat the analysis)."""
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma,
+    )

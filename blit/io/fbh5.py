@@ -652,6 +652,39 @@ def _cursor_path(out_path: str) -> str:
     return out_path + ".cursor"
 
 
+def resume_target_ok(path: str, nifs: int, nchans: int, rows: int) -> bool:
+    """Can ``path`` back a resume claiming ``rows`` spectra?
+
+    The crash-resume protocol fsyncs data before the cursor claims it,
+    but libhdf5's in-place metadata updates between checkpoints are NOT
+    crash-atomic: a SIGKILL/power loss can leave a file that no longer
+    opens as HDF5 — or whose claimed prefix no longer reads — while the
+    cursor sidecar (written via its own tmp-rename+fsync) still parses
+    (ADVICE r5 medium).  Resume callers probe with this BEFORE trusting
+    the cursor: ``False`` means fall back to a fresh start exactly like
+    a cursor-identity mismatch (logging what was discarded), instead of
+    raising and wedging resume until an operator deletes the file by
+    hand.
+
+    The probe opens the file, checks the dataset geometry covers the
+    claim, and decodes the last claimed row (one chunk read — under
+    bitshuffle the cursor only ever claims flushed chunks, so that row
+    must decode).  Any failure anywhere is a ``False``, not an error.
+    """
+    try:
+        with h5py.File(path, "r") as h5:
+            ds = h5["data"]
+            if ds.shape[1:] != (nifs, nchans) or ds.shape[0] < rows:
+                return False
+        if rows > 0:
+            read_fbh5_data(
+                path, (slice(rows - 1, rows), slice(None), slice(None))
+            )
+        return True
+    except Exception:  # noqa: BLE001 — any unreadability means start fresh
+        return False
+
+
 def write_fbh5(
     path: str,
     header: Dict,

@@ -32,6 +32,8 @@ from typing import Optional
 import numpy as np
 
 import jax
+
+from blit.compat import shard_map
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -181,7 +183,7 @@ def beamform(
         return br, bi
 
     out_specs = P() if detect else (P(), P())
-    out = jax.shard_map(
+    out = shard_map(
         step,
         mesh=mesh,
         in_specs=(P(axis), P(axis), P(None, axis), P(None, axis)),
@@ -264,7 +266,7 @@ def _beamform_chan(
         return br, bi
 
     out_specs = P() if (detect or fuse) else (P(), P())
-    out = jax.shard_map(
+    out = shard_map(
         step,
         mesh=mesh,
         in_specs=(P(None, axis), P(None, axis), P(None, None, axis),
@@ -278,6 +280,131 @@ def _beamform_chan(
     # Same complex-output contract as the antenna layout: complex64 when
     # BOTH inputs were complex, else the planar pair.
     return jax.lax.complex(br, bi) if complex_out else (br, bi)
+
+
+# -- windowed streaming beamforming ----------------------------------------
+
+def beamform_stream(
+    feed,
+    weights: ComplexOrPlanar,
+    *,
+    mesh: Mesh,
+    axis: str = ANT_AXIS_DEFAULT,
+    nint: int = 1,
+    layout: str = "antenna",
+    timeline=None,
+):
+    """Stream detected tied-array beam powers over a windowed feed
+    (:class:`blit.parallel.antenna.AntennaStream`) — the arbitrarily-
+    long-recording form of ``beamform(detect=True)``.
+
+    Yields one float32 power slab per window, in time order:
+    ``(nbeam, nchan, wt // nint, npol)`` (antenna layout) /
+    ``(nchan, nbeam, npol, wt // nint)`` (chan layout).  Concatenated
+    along the time axis the slabs are byte-identical to the one-shot
+    ``beamform`` on the same span — per-sample phase/detect math and the
+    per-``nint`` integration folds are window-local, so windowing changes
+    no float operation (the equivalence tests pin this, arbitrary
+    ``start_sample`` included).
+
+    Every window must hold a whole number of integrations (pick
+    ``window_samples`` — and a total span — divisible by ``nint``);
+    integration therefore never straddles a window boundary, the same
+    chunk rule :class:`blit.pipeline.RawReducer` applies via
+    ``chunk_frames``.
+
+    Pipelining: window ``w`` dispatches asynchronously; ``w-1``'s wait +
+    readback happen after the feed transferred ``w`` (its producer thread
+    is reading ``w+1`` behind that) — host reads, transfer and compute
+    overlap at ``prefetch_depth`` windows of host memory.
+
+    Stage timings land in ``timeline``: ``dispatch`` (async), ``device``
+    (lag-synchronized wait on a window's collectives), ``readback``
+    (device→host slab fetch).
+    """
+    import numpy as _np
+
+    from blit.observability import Timeline
+
+    tl = timeline if timeline is not None else Timeline()
+
+    def finish(item):
+        win, out = item
+        with tl.stage("device", byte_free=True):
+            out.block_until_ready()
+        with tl.stage("readback"):
+            slab = _np.asarray(out)
+        tl.stages["readback"].bytes += slab.nbytes
+        # The window's compute is synchronized: its host slot (which the
+        # arrays may alias — Window.release contract) can refill now.
+        win.release()
+        return slab
+
+    pending = None
+    for win in feed:
+        if win.ntime % nint:
+            raise ValueError(
+                f"window {win.index} holds {win.ntime} samples — not a "
+                f"whole number of nint={nint} integrations; choose "
+                "window_samples (and span) divisible by nint"
+            )
+        with tl.stage("dispatch", byte_free=True):
+            out = beamform(
+                win.arrays, weights, mesh=mesh, axis=axis, nint=nint,
+                detect=True, layout=layout,
+            )
+        if pending is not None:
+            yield finish(pending)
+        pending = (win, out)
+    if pending is not None:
+        yield finish(pending)
+
+
+def beamform_accumulate(
+    feed,
+    weights: ComplexOrPlanar,
+    *,
+    mesh: Mesh,
+    axis: str = ANT_AXIS_DEFAULT,
+    layout: str = "antenna",
+    timeline=None,
+):
+    """Total integrated beam power over a whole windowed feed, the
+    integration state carried across window boundaries ON-DEVICE: each
+    window's power (integrated over its full extent) folds into a donated
+    float32 accumulator, and one ``(nbeam, nchan, 1, npol)`` (antenna
+    layout) / ``(nchan, nbeam, npol, 1)`` (chan layout) array crosses
+    back at the end — the bounded-output companion to
+    :func:`beamform_stream` for total-power monitoring of recordings of
+    any length."""
+    import jax as _jax
+
+    from blit.observability import Timeline
+
+    tl = timeline if timeline is not None else Timeline()
+    acc = None
+    prev = None
+    add = _jax.jit(lambda a, p: a + p, donate_argnums=0)
+    for win in feed:
+        if prev is not None:
+            # Lag-1: wait for the previous window's fold (its power output
+            # implies its input was consumed), then recycle its slot.
+            with tl.stage("device", byte_free=True):
+                prev[1].block_until_ready()
+            prev[0].release()
+        with tl.stage("dispatch", byte_free=True):
+            p = beamform(
+                win.arrays, weights, mesh=mesh, axis=axis, nint=win.ntime,
+                detect=True, layout=layout,
+            )
+            acc = p if acc is None else add(acc, p)
+        prev = (win, p)
+    if acc is None:
+        raise ValueError("beamform_accumulate: feed yielded no windows")
+    with tl.stage("device", byte_free=True):
+        acc.block_until_ready()
+    prev[0].release()
+    return acc
 
 
 def antenna_sharding(mesh: Mesh, axis: str = ANT_AXIS_DEFAULT) -> NamedSharding:

@@ -30,11 +30,15 @@ two-HBM-passes saving the filterbank path uses (DESIGN.md §2).
 from __future__ import annotations
 
 import functools
+from typing import Iterable, Optional
+
 from blit.ops.dft import ComplexOrPlanar, Planar, as_planar
 
 import numpy as np
 
 import jax
+
+from blit.compat import shard_map
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -42,6 +46,21 @@ from blit.ops.channelize import fft_planar, pfb_frontend
 
 BAND_AXIS = "band"
 BANK_AXIS = "bank"
+
+# Dispatch resolution of the most recent X-engine TRACE (the
+# blit.ops.channelize._LAST_PLAN convention, mirrored from
+# blit.parallel.beamform.last_beamform_plan): the pallas-vs-einsum gate
+# evaluates on per-shard LOCAL shapes inside shard_map, so provenance
+# consumers (bench.py) must read the actual decision here instead of
+# re-deriving it from global shapes (ADVICE r5 low finding).
+_LAST_PLAN: dict = {}
+
+
+def last_xengine_plan() -> dict:
+    """The most recent X-engine dispatch decision (``{"layout": ...,
+    "engine": "pallas" | "einsum"}``; empty until a trace happens — a jit
+    cache hit does not refresh it)."""
+    return dict(_LAST_PLAN)
 
 
 def f_engine_planar(
@@ -95,6 +114,8 @@ def _xengine_planar(sr: jax.Array, si: jax.Array) -> Planar:
     concatenate materializes an extra copy of both spectra planes, and
     the MXU tiles were not the binding resource.
     """
+    _LAST_PLAN.clear()
+    _LAST_PLAN.update({"layout": "standard", "engine": "einsum"})
     return _xengine_einsums(sr, si, "abcfpq")
 
 
@@ -127,15 +148,46 @@ def _xengine_packed(sr: jax.Array, si: jax.Array) -> Planar:
     ft = pallas_xengine.pick_ft(
         nap, sr.shape[-1], sr.shape[3], itemsize=sr.dtype.itemsize
     )
-    if jax.default_backend() in _MATMUL_ONLY_BACKENDS and ft is not None:
+    fused = jax.default_backend() in _MATMUL_ONLY_BACKENDS and ft is not None
+    _LAST_PLAN.clear()
+    _LAST_PLAN.update(
+        {"layout": "packed", "engine": "pallas" if fused else "einsum"}
+    )
+    if fused:
         vr, vi = pallas_xengine.xengine_packed(sr, si, ft=ft)
         shape6 = vr.shape[:2] + (nant, npol, nant, npol)
         return vr.reshape(shape6), vi.reshape(shape6)
     return _xengine_einsums(sr, si, "cfapbq")
 
 
+def _fx_spectra(vr: jax.Array, vi: jax.Array, h: jax.Array,
+                bf16: bool) -> Planar:
+    """Per-chip F-engine body shared by every correlator entry point:
+    planar voltages ``(nant, nchan_local, ntime_local, npol)`` → fftshifted
+    planar spectra ``(nant, nchan_local, npol, nframes, nfft)``, staged in
+    bf16 when the planes are bf16-resident (DESIGN.md §9 r5)."""
+    if bf16:
+        h = h.astype(jnp.bfloat16)
+    # Move pol before time so the F-engine framing acts on the last axis.
+    sr, si = f_engine_planar(
+        jnp.moveaxis(vr, 3, 2), jnp.moveaxis(vi, 3, 2), h
+    )
+    if bf16:
+        sr = sr.astype(jnp.bfloat16)
+        si = si.astype(jnp.bfloat16)
+    return sr, si
+
+
+def _fx_xengine(sr: jax.Array, si: jax.Array, vis_layout: str) -> Planar:
+    """X-engine dispatch by output layout (shared per-chip body)."""
+    if vis_layout == "packed":
+        return _xengine_packed(sr, si)
+    return _xengine_planar(sr, si)
+
+
 @functools.partial(
-    jax.jit, static_argnames=("mesh", "nfft", "ntap", "vis_layout")
+    jax.jit,
+    static_argnames=("mesh", "nfft", "ntap", "vis_layout", "acc_frames"),
 )
 def correlate(
     voltages: ComplexOrPlanar,
@@ -145,6 +197,7 @@ def correlate(
     nfft: int,
     ntap: int = 4,
     vis_layout: str = "standard",
+    acc_frames: Optional[int] = None,
 ):
     """Full FX correlation over the mesh.
 
@@ -176,6 +229,16 @@ def correlate(
     ``ntap-1`` frames per boundary are not formed (standard chunked-
     correlator behavior; :func:`correlate_np` with ``nsegments=nband`` is
     the exact golden reference).
+
+    ``acc_frames`` pins the visibility accumulation granularity: each band
+    row's frame contraction folds tile-by-tile (``acc_frames`` frames per
+    tile, time-ascending) instead of as one contraction.  This is the
+    accumulation structure of the windowed streaming path
+    (:func:`correlate_stream` with ``window_frames=acc_frames``), so the
+    float32 results are byte-identical between the two — the equivalence
+    the long-recording tests pin.  ``None`` (default) keeps the single
+    contraction (same result to float rounding; one big MXU contraction
+    is the fast shape).
     """
     if vis_layout not in ("standard", "packed"):
         raise ValueError(f"bad vis_layout {vis_layout!r}")
@@ -190,27 +253,30 @@ def correlate(
     bf16 = vr.dtype == jnp.bfloat16
 
     def step(vr, vi, h):
-        if bf16:
-            h = h.astype(jnp.bfloat16)
-        # v: (nant, nchan_local, ntime_local, npol) — move pol before time so
-        # the F-engine framing acts on the last axis.
-        sr, si = f_engine_planar(
-            jnp.moveaxis(vr, 3, 2), jnp.moveaxis(vi, 3, 2), h
-        )  # (a, c, p, frames, nfft) each
-        if bf16:
-            sr = sr.astype(jnp.bfloat16)
-            si = si.astype(jnp.bfloat16)
-        if vis_layout == "packed":
-            visr, visi = _xengine_packed(sr, si)
+        sr, si = _fx_spectra(vr, vi, h, bf16)  # (a, c, p, frames, nfft)
+        nframes = sr.shape[3]
+        if acc_frames is None or acc_frames >= nframes:
+            visr, visi = _fx_xengine(sr, si, vis_layout)
         else:
-            visr, visi = _xengine_planar(sr, si)
+            # Tile-by-tile fold, time-ascending — the windowed stream's
+            # exact accumulation order (first tile un-added, like the
+            # stream's first window, so even signed zeros match).
+            visr = visi = None
+            for t0 in range(0, nframes, acc_frames):
+                pr, pi = _fx_xengine(
+                    sr[..., t0:t0 + acc_frames, :],
+                    si[..., t0:t0 + acc_frames, :],
+                    vis_layout,
+                )
+                visr = pr if visr is None else visr + pr
+                visi = pi if visi is None else visi + pi
         return jax.lax.psum((visr, visi), BAND_AXIS)
 
     spec_v = P(None, BANK_AXIS, BAND_AXIS)
     out_spec = (
         P(BANK_AXIS) if vis_layout == "packed" else P(None, None, BANK_AXIS)
     )
-    visr, visi = jax.shard_map(
+    visr, visi = shard_map(
         step,
         mesh=mesh,
         in_specs=(spec_v, spec_v, P()),
@@ -233,6 +299,159 @@ def visibility_sharding(mesh: Mesh) -> NamedSharding:
     """Output sharding: (nant, nant, nchan, nfft, npol, npol), frequency
     over ``bank``, replicated over ``band``."""
     return NamedSharding(mesh, P(None, None, BANK_AXIS))
+
+
+# -- windowed streaming correlation ----------------------------------------
+#
+# The accumulator is BAND-SHARDED partial visibilities with a leading band
+# axis — each band row folds its own windows locally and the band psum runs
+# exactly once, at the end (``psum(fold(local))``, the same structure as
+# ``correlate(acc_frames=...)``'s in-step fold, which is what makes the
+# float32 stream byte-identical to the one-shot call).
+
+def _acc_spec(vis_layout: str) -> P:
+    """PartitionSpec of the band-sharded partial-visibility accumulator:
+    standard ``(nband, nant, nant, nchan, nfft, npol, npol)`` / packed
+    ``(nband, nchan, nfft, nant, npol, nant, npol)``."""
+    if vis_layout == "packed":
+        return P(BAND_AXIS, BANK_AXIS)
+    return P(BAND_AXIS, None, None, BANK_AXIS)
+
+
+_SPEC_V = P(None, BANK_AXIS, BAND_AXIS)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "vis_layout"))
+def _window_vis(vr, vi, h, *, mesh: Mesh, vis_layout: str):
+    """First window: per-chip F-engine + X-engine partials, NO psum —
+    the band-sharded accumulator's initial value."""
+    bf16 = vr.dtype == jnp.bfloat16
+
+    def step(vr, vi, h):
+        sr, si = _fx_spectra(vr, vi, h, bf16)
+        pr, pi = _fx_xengine(sr, si, vis_layout)
+        return pr[None], pi[None]  # leading band block axis
+
+    spec = _acc_spec(vis_layout)
+    return shard_map(
+        step, mesh=mesh, in_specs=(_SPEC_V, _SPEC_V, P()),
+        out_specs=(spec, spec), check_vma=False,
+    )(vr, vi, h)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("mesh", "vis_layout"), donate_argnums=(0, 1)
+)
+def _accum_vis(accr, acci, vr, vi, h, *, mesh: Mesh, vis_layout: str):
+    """Subsequent windows: fold this window's partials into the donated
+    accumulator (HBM reused in place across the whole stream)."""
+    bf16 = vr.dtype == jnp.bfloat16
+
+    def step(ar, ai, vr, vi, h):
+        sr, si = _fx_spectra(vr, vi, h, bf16)
+        pr, pi = _fx_xengine(sr, si, vis_layout)
+        return ar + pr[None], ai + pi[None]
+
+    spec = _acc_spec(vis_layout)
+    return shard_map(
+        step, mesh=mesh, in_specs=(spec, spec, _SPEC_V, _SPEC_V, P()),
+        out_specs=(spec, spec), check_vma=False,
+    )(accr, acci, vr, vi, h)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "vis_layout"))
+def _finish_vis(accr, acci, *, mesh: Mesh, vis_layout: str):
+    """The stream's ONE collective: psum the band-local partials into the
+    integrated visibilities, with :func:`correlate`'s output sharding."""
+
+    def step(ar, ai):
+        ar, ai = jax.lax.psum((ar, ai), BAND_AXIS)
+        return ar[0], ai[0]  # drop the leading band block axis
+
+    spec = _acc_spec(vis_layout)
+    out = (
+        P(BANK_AXIS) if vis_layout == "packed" else P(None, None, BANK_AXIS)
+    )
+    return shard_map(
+        step, mesh=mesh, in_specs=(spec, spec), out_specs=(out, out),
+        check_vma=False,  # psum output is band-invariant
+    )(accr, acci)
+
+
+def correlate_stream(
+    feed: Iterable,
+    coeffs: jax.Array,
+    *,
+    mesh: Mesh,
+    nfft: int,
+    ntap: int = 4,
+    vis_layout: str = "standard",
+    timeline=None,
+) -> Planar:
+    """Full FX correlation over a windowed feed
+    (:class:`blit.parallel.antenna.CorrelatorStream`) — the arbitrarily-
+    long-recording form of :func:`correlate`: per-window local partials
+    fold into an on-device band-sharded accumulator (donated, so windows
+    reuse HBM), the band psum runs once at the end, and only the final
+    visibilities exist whole.
+
+    Pipelining: window ``w``'s dispatch is asynchronous; the blocking wait
+    on window ``w-1``'s fold happens AFTER the feed has already
+    transferred window ``w`` (and while its producer thread reads window
+    ``w+1``), so host reads, host→device transfer and device compute
+    overlap — the ``RawReducer.drain`` lag pattern.
+
+    Numerics: byte-identical (float32) to
+    ``correlate(..., acc_frames=window_frames)`` on the same span — same
+    per-window contractions, same time-ascending fold (the long-recording
+    equivalence tests pin this, arbitrary ``start_sample`` included); the
+    default one-shot ``correlate`` differs only by float summation order.
+
+    Returns the planar ``(visr, visi)`` pair with :func:`correlate`'s
+    output contract.  Stage timings land in ``timeline``: ``dispatch``
+    (async window fold), ``device`` (lag-synchronized wait).
+    """
+    from blit.observability import Timeline
+
+    if vis_layout not in ("standard", "packed"):
+        raise ValueError(f"bad vis_layout {vis_layout!r}")
+    if coeffs.shape != (ntap, nfft):
+        raise ValueError(
+            f"coeffs shape {coeffs.shape} != (ntap={ntap}, nfft={nfft})"
+        )
+    tl = timeline if timeline is not None else Timeline()
+    accr = acci = None
+    prev = None
+    for win in feed:
+        vr, vi = win.arrays
+        if accr is not None:
+            # Lag-1 sync: wait for window w-1's fold only now — the feed
+            # already moved window w and is reading w+1 behind it.  The
+            # synced fold consumed w-1's arrays, so its slot can refill
+            # (Window.release contract).
+            with tl.stage("device", byte_free=True):
+                jax.block_until_ready(accr)
+            prev.release()
+        with tl.stage("dispatch", byte_free=True):
+            if accr is None:
+                accr, acci = _window_vis(
+                    vr, vi, coeffs, mesh=mesh, vis_layout=vis_layout
+                )
+            else:
+                accr, acci = _accum_vis(
+                    accr, acci, vr, vi, coeffs,
+                    mesh=mesh, vis_layout=vis_layout,
+                )
+        prev = win
+    if accr is None:
+        raise ValueError("correlate_stream: feed yielded no windows")
+    with tl.stage("device", byte_free=True):
+        visr, visi = _finish_vis(
+            accr, acci, mesh=mesh, vis_layout=vis_layout
+        )
+        jax.block_until_ready((visr, visi))
+    prev.release()
+    return visr, visi
 
 
 def correlate_np(

@@ -22,6 +22,8 @@ from typing import Optional, Tuple
 import numpy as np
 
 import jax
+
+from blit.compat import shard_map
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -144,7 +146,7 @@ def band_reduce(
 
     # check_vma=False when stitching: the varying-mesh-axes analysis cannot
     # statically see that all_gather's output is bank-invariant.
-    return jax.shard_map(
+    return shard_map(
         step, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
         check_vma=not stitch,
     )(voltages, coeffs)
@@ -160,7 +162,7 @@ def stitch_bands(x: jax.Array, mesh: Mesh) -> jax.Array:
     def gather(blk):
         return jax.lax.all_gather(blk, BANK_AXIS, axis=3, tiled=True)
 
-    return jax.shard_map(
+    return shard_map(
         gather,
         mesh=mesh,
         in_specs=P(BAND_AXIS, None, None, BANK_AXIS),

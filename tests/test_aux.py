@@ -38,6 +38,33 @@ class TestTimeline:
             x = 1
         assert x == 1
 
+    def test_byte_free_flag_survives_to_report(self):
+        tl = Timeline()
+        with tl.stage("wait", byte_free=True):
+            pass
+        with tl.stage("move", nbytes=10):
+            pass
+        rep = tl.report()
+        assert rep["wait"]["byte_free"] is True
+        assert "byte_free" not in rep["move"]
+        assert tl.stages["wait"].byte_free
+
+    def test_snapshot_since_deltas(self):
+        # The per-window stage record the windowed drivers report.
+        tl = Timeline()
+        with tl.stage("read", nbytes=100):
+            pass
+        snap = tl.snapshot()
+        with tl.stage("read", nbytes=50):
+            pass
+        with tl.stage("write", nbytes=7):
+            pass
+        delta = tl.since(snap)
+        assert delta["read"]["calls"] == 1
+        assert delta["read"]["bytes"] == 50
+        assert delta["write"]["bytes"] == 7
+        assert tl.since(tl.snapshot()) == {}
+
     def test_host_context_logging(self, capsys, blit_logger_restored):
         logger = logging.getLogger("blit.testlog")
         configure_logging(worker=7)

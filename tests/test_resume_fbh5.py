@@ -251,6 +251,54 @@ class TestReduceResumableH5:
         np.testing.assert_array_equal(read_fbh5_data(out), want)
 
 
+class TestCorruptTargetFallback:
+    """ADVICE r5 medium: libhdf5 metadata updates between checkpoints are
+    not crash-atomic — a SIGKILL can leave a target the resume path cannot
+    open while the cursor sidecar still parses.  The resume must fall back
+    to a fresh start (identity-mismatch behavior), never raise."""
+
+    def test_probe_rejects_garbage_and_accepts_good(self, tmp_path):
+        from blit.io.fbh5 import resume_target_ok
+        from blit.io.fbh5 import write_fbh5
+
+        good = str(tmp_path / "good.h5")
+        data = np.random.default_rng(0).standard_normal(
+            (6, 1, 8)).astype(np.float32)
+        write_fbh5(good, HDR, data)
+        assert resume_target_ok(good, 1, 8, 6)
+        assert not resume_target_ok(good, 1, 8, 7)  # claims > rows
+        assert not resume_target_ok(good, 2, 8, 4)  # wrong geometry
+        bad = str(tmp_path / "bad.h5")
+        with open(bad, "wb") as f:
+            f.write(b"\x00not hdf5 at all" * 64)
+        assert not resume_target_ok(bad, 1, 8, 1)
+        assert not resume_target_ok(str(tmp_path / "absent.h5"), 1, 8, 1)
+
+    def test_corrupt_target_restarts_fresh(self, tmp_path, raw, caplog):
+        import logging
+
+        out = str(tmp_path / "x.h5")
+        orig, crashing = crash_after(1)
+        try:
+            RawReducer.stream = crashing
+            with pytest.raises(Boom):
+                make_red().reduce_resumable(raw, out)
+        finally:
+            RawReducer.stream = orig
+        cur = ReductionCursor.load(out)
+        assert cur is not None and cur.frames_done > 0
+        # Smash the HDF5 superblock — the file no longer opens, but the
+        # cursor (own tmp-rename+fsync discipline) still parses.
+        with open(out, "r+b") as f:
+            f.write(b"\xde\xad\xbe\xef" * 128)
+        with caplog.at_level(logging.WARNING, logger="blit.pipeline"):
+            make_red().reduce_resumable(raw, out)
+        assert "starting fresh" in caplog.text
+        _, want = make_red().reduce(raw)
+        np.testing.assert_array_equal(read_fbh5_data(out), want)
+        assert not os.path.exists(ReductionCursor.path_for(out))
+
+
 class TestSigkillResume:
     def test_sigkill_mid_reduction_resumes_identically(self, tmp_path):
         # The real crash, not an injected exception: a subprocess running

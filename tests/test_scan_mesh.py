@@ -151,3 +151,25 @@ class TestReviewRegressions:
         assert _pick_tile(96, 512) == 96     # small extents stay whole
         assert _pick_tile(1024, 512) == 512
         assert _pick_tile(997, 512) == 1     # prime: degenerate but bounded
+
+
+class TestWindowChunkRows:
+    def test_coprime_window_rows_warn(self, caplog):
+        import logging
+
+        from blit.parallel.scan import _bitshuffle_window_chunk_rows
+
+        with caplog.at_level(logging.WARNING, logger="blit.scan"):
+            assert _bitshuffle_window_chunk_rows(16, 5) == 1
+        assert "collapse" in caplog.text  # ADVICE r5: no silent 1-row chunks
+
+    def test_dividing_window_rows_stay_silent(self, caplog):
+        import logging
+
+        from blit.parallel.scan import _bitshuffle_window_chunk_rows
+
+        with caplog.at_level(logging.WARNING, logger="blit.scan"):
+            assert _bitshuffle_window_chunk_rows(16, 8) == 8   # divides
+            assert _bitshuffle_window_chunk_rows(16, 32) == 16  # multiple
+            assert _bitshuffle_window_chunk_rows(16, 16) == 16
+        assert "collapse" not in caplog.text

@@ -30,6 +30,8 @@ import time
 import numpy as np
 
 import jax
+
+from blit.compat import shard_map
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
@@ -103,7 +105,7 @@ def main() -> int:
             bi = bi.astype(jnp.float32)
             return integrate(br**2 + bi**2, nint)
 
-        return jax.shard_map(
+        return shard_map(
             step, mesh=mesh,
             in_specs=(P("bank"), P("bank"), P(None, "bank"),
                       P(None, "bank")),
