@@ -17,6 +17,8 @@ Layer map (mirrors SURVEY.md §1, rebuilt TPU-first):
 - ``blit.parallel``  — the (band, bank) ``jax.sharding.Mesh``, worker pools,
   all_gather band stitching, psum beamforming, FX correlation.
 - ``blit.pipeline``  — GUPPI RAW → high-resolution filterbank reduction driver.
+- ``blit.faults``    — deterministic fault injection + recovery policy
+  (transient-I/O retry, circuit breakers, degradation counters).
 """
 
 from blit.version import __version__
@@ -38,6 +40,7 @@ def __getattr__(name):
         "naming",
         "config",
         "testing",
+        "faults",
     ):
         import importlib
 

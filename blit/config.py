@@ -71,6 +71,49 @@ class SiteConfig:
     # still bounds committing NEW work to a wedged agent either way.
     call_timeout: Optional[float] = None
     ping_timeout: Optional[float] = 30.0
+    # Transient-failure recovery (blit/faults.py; ISSUE 2).  io_retries is
+    # the TOTAL attempts for worker-side file I/O (guppi/fbh5/filterbank
+    # reads — flaky NFS weather); call_retries is the number of
+    # RE-dispatches of a WorkerPool remote call after AgentDied/CallTimeout
+    # (each re-dispatch rides the pool's existing agent respawn).  Backoff
+    # is jittered-exponential; retry_seed pins the jitter for
+    # deterministic tests.
+    io_retries: int = 3
+    io_backoff_s: float = 0.05
+    io_backoff_max_s: float = 2.0
+    call_retries: int = 2
+    call_backoff_s: float = 0.5
+    call_backoff_max_s: float = 10.0
+    retry_jitter: float = 0.5
+    retry_seed: Optional[int] = None
+    # Per-worker circuit breaker: breaker_threshold CONSECUTIVE remote-call
+    # failures trip the host into a "degraded" state (calls fail fast with
+    # RemoteError(etype="HostDegraded") instead of hammering it); after
+    # breaker_cooldown_s one probe call may re-close the circuit.
+    breaker_threshold: int = 3
+    breaker_cooldown_s: float = 60.0
+
+    def io_retry_policy(self):
+        """The :class:`blit.faults.RetryPolicy` for worker-side file I/O —
+        install it process-wide with ``faults.set_io_policy(...)``."""
+        from blit import faults
+
+        return faults.RetryPolicy(
+            attempts=max(1, self.io_retries), base_s=self.io_backoff_s,
+            max_s=self.io_backoff_max_s, jitter=self.retry_jitter,
+            seed=self.retry_seed,
+        )
+
+    def call_retry_policy(self):
+        """The :class:`blit.faults.RetryPolicy` for WorkerPool remote-call
+        re-dispatch (``attempts = call_retries + 1``)."""
+        from blit import faults
+
+        return faults.RetryPolicy(
+            attempts=max(0, self.call_retries) + 1,
+            base_s=self.call_backoff_s, max_s=self.call_backoff_max_s,
+            jitter=self.retry_jitter, seed=self.retry_seed,
+        )
 
     def __post_init__(self):
         if self.hosts is None:

@@ -1,0 +1,425 @@
+"""Deterministic fault injection + recovery-policy primitives.
+
+The failure story of the streaming collective plane (ISSUE 2): long
+reductions must *degrade and continue* instead of dying on the first
+transient ``OSError``, and every recovery path must be exercisable
+deterministically in tests rather than discovered in production.  This
+module holds both halves:
+
+- **Injection** (:class:`FaultRule`, :func:`fire`): a seeded,
+  config/env-driven registry of named injection points threaded through
+  the I/O layer (``guppi.read`` / ``guppi.open`` / ``fbh5.write`` /
+  ``workers.read``), the stream producer threads (``antenna.produce``)
+  and the remote transport (``remote.call``).  Modes: ``fail`` (raise
+  :class:`InjectedFault` — an ``OSError``, so retry paths treat it like
+  a flaky NFS read), ``delay`` (injectable sleep), ``truncate`` (short
+  read — a *hard* failure the degraded-antenna masking handles) and
+  ``corrupt`` (bit-flip the delivered frame).  Rules fire on exact hit
+  counts (``after``/``times``), so a test can target "window 3 of
+  antenna 2" and get the same failure every run.  ``BLIT_FAULTS`` in
+  the environment arms rules at import time for CLI-level drills (see
+  docs/WORKFLOWS.md "Failure modes & runbook").
+
+- **Recovery** (:class:`RetryPolicy`, :func:`retry_call`,
+  :class:`CircuitBreaker`): jittered-exponential-backoff retry with
+  bounded attempts, *seeded* jitter and an injectable ``sleep`` (tests
+  never sleep real backoff time), and a per-host circuit breaker that
+  trips into a ``degraded`` state after repeated failures instead of
+  hammering a dead host.  Knobs live in :class:`blit.config.SiteConfig`.
+
+- **Counters** (:func:`incr` / :func:`counters`): process-wide
+  retry/mask/trip totals, surfaced through
+  ``Timeline.report(include_faults=True)`` (blit/observability.py) so a
+  degraded run says so in its report.
+
+Imports nothing from the rest of blit — every layer can depend on it.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+log = logging.getLogger("blit.faults")
+
+MODES = ("fail", "delay", "truncate", "corrupt")
+
+
+class InjectedFault(OSError):
+    """The default injected failure: an ``OSError`` subclass, so the
+    transient-I/O retry paths classify it exactly like a flaky NFS read."""
+
+
+# -- counters ---------------------------------------------------------------
+
+_counters_lock = threading.Lock()
+_counters: Dict[str, int] = {}
+
+
+def incr(name: str, n: int = 1) -> None:
+    """Bump a process-wide failure/recovery counter (thread-safe)."""
+    with _counters_lock:
+        _counters[name] = _counters.get(name, 0) + n
+
+
+def counters() -> Dict[str, int]:
+    """Snapshot of all nonzero counters (``retry.io``, ``retry.remote``,
+    ``mask.antenna``, ``breaker.trip``, ``fault.<point>.<mode>`` ...)."""
+    with _counters_lock:
+        return dict(_counters)
+
+
+def reset_counters() -> None:
+    with _counters_lock:
+        _counters.clear()
+
+
+# -- injection registry -----------------------------------------------------
+
+
+@dataclass
+class FaultRule:
+    """One armed injection: fire ``mode`` at ``point`` for matching hits
+    ``(after, after + times]`` (``times=-1`` = every matching hit).
+
+    ``match`` filters by substring of the call-site key (a file path, a
+    host name, an antenna recording path), so a rule can target one
+    antenna of a 64-element array.  ``sleep`` makes ``delay`` rules
+    interruptible/observable in tests.  ``amount`` is the samples cut by
+    ``truncate`` (0 = half the request)."""
+
+    point: str
+    mode: str = "fail"
+    times: int = 1
+    after: int = 0
+    match: Optional[str] = None
+    exc: type = InjectedFault
+    message: str = "injected fault"
+    delay_s: float = 0.1
+    amount: int = 0
+    sleep: Callable[[float], None] = time.sleep
+    # Mutable bookkeeping (under the registry lock).
+    hits: int = 0
+    fired: int = 0
+
+    def __post_init__(self):
+        if self.mode not in MODES:
+            raise ValueError(f"unknown fault mode {self.mode!r}; one of {MODES}")
+
+
+class _Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.rules: List[FaultRule] = []
+
+    def install(self, *rules: FaultRule) -> None:
+        with self._lock:
+            self.rules = self.rules + list(rules)
+
+    def clear(self) -> None:
+        with self._lock:
+            self.rules = []
+
+    def fire(self, point: str, key=None) -> Optional[FaultRule]:
+        """Evaluate every armed rule for ``point``: count the hit, apply
+        delays, raise failures, or return the first destructive rule
+        (truncate/corrupt) for the caller to apply to its data."""
+        todo: List[FaultRule] = []
+        with self._lock:
+            for r in self.rules:
+                if r.point != point:
+                    continue
+                if r.match is not None and (
+                    key is None or r.match not in str(key)
+                ):
+                    continue
+                r.hits += 1
+                if r.hits <= r.after:
+                    continue
+                if r.times >= 0 and r.hits > r.after + r.times:
+                    continue
+                r.fired += 1
+                incr(f"fault.{point}.{r.mode}")
+                todo.append(r)
+                if r.mode != "delay":
+                    break  # first destructive rule wins
+        act = None
+        for r in todo:  # apply OUTSIDE the lock (sleep / raise)
+            if r.mode == "delay":
+                log.warning("injected delay %.3fs @ %s [%s]", r.delay_s,
+                            point, key)
+                r.sleep(r.delay_s)
+            elif r.mode == "fail":
+                raise r.exc(
+                    f"{r.message} @ {point}" + (f" [{key}]" if key else "")
+                )
+            else:
+                act = r
+        return act
+
+
+_REGISTRY = _Registry()
+
+
+def install(*rules: FaultRule) -> None:
+    """Arm injection rules (appended to any already armed)."""
+    _REGISTRY.install(*rules)
+
+
+def clear() -> None:
+    """Disarm every rule (tests: pair with :func:`reset_counters`)."""
+    _REGISTRY.clear()
+
+
+def active() -> List[FaultRule]:
+    return list(_REGISTRY.rules)
+
+
+def fire(point: str, key=None) -> Optional[FaultRule]:
+    """The injection call sites' entry point.  No armed rules (the
+    production fast path) is one attribute read.  May raise (``fail``),
+    sleep (``delay``) or return a rule whose ``mode`` in
+    ``("truncate", "corrupt")`` the caller applies to its data."""
+    if not _REGISTRY.rules:
+        return None
+    return _REGISTRY.fire(point, key)
+
+
+def parse_spec(spec: str) -> List[FaultRule]:
+    """Parse the ``BLIT_FAULTS`` drill grammar: semicolon-separated
+    ``point:mode[:times][:k=v...]`` with ``k`` in
+    ``match/after/delay/amount/message`` —
+    e.g. ``"guppi.read:fail:2:match=ant1;remote.call:delay:delay=0.5"``."""
+    rules = []
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        fields = part.split(":")
+        if len(fields) < 2:
+            raise ValueError(f"BLIT_FAULTS entry needs point:mode — {part!r}")
+        kw: Dict[str, object] = {"point": fields[0], "mode": fields[1]}
+        for f in fields[2:]:
+            if "=" not in f:
+                kw["times"] = int(f)
+                continue
+            k, v = f.split("=", 1)
+            if k in ("times", "after", "amount"):
+                kw[k] = int(v)
+            elif k == "delay":
+                kw["delay_s"] = float(v)
+            elif k in ("match", "message"):
+                kw[k] = v
+            else:
+                raise ValueError(f"BLIT_FAULTS: unknown key {k!r} in {part!r}")
+        rules.append(FaultRule(**kw))
+    return rules
+
+
+def install_spec(spec: str) -> List[FaultRule]:
+    rules = parse_spec(spec)
+    install(*rules)
+    return rules
+
+
+if os.environ.get("BLIT_FAULTS"):
+    try:
+        install_spec(os.environ["BLIT_FAULTS"])
+        log.warning("BLIT_FAULTS armed: %s", os.environ["BLIT_FAULTS"])
+    except Exception as e:  # noqa: BLE001 — a bad drill spec must be loud
+        raise ValueError(
+            f"malformed BLIT_FAULTS={os.environ['BLIT_FAULTS']!r}: {e}"
+        ) from e
+
+
+# -- retry policy -----------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Jittered exponential backoff with bounded attempts.
+
+    ``attempts`` is the TOTAL number of tries (1 = no retry).  Jitter is
+    uniform in ``delay * (1 ± jitter)``; with ``seed`` set the jitter for
+    attempt ``k`` is a pure function of ``(seed, k)`` — deterministic
+    across runs, different across attempts.  ``sleep`` is injectable so
+    tests record delays instead of serving them."""
+
+    attempts: int = 3
+    base_s: float = 0.05
+    max_s: float = 2.0
+    multiplier: float = 2.0
+    jitter: float = 0.5
+    seed: Optional[int] = None
+    sleep: Callable[[float], None] = time.sleep
+
+    def delay_s(self, attempt: int) -> float:
+        d = min(self.max_s, self.base_s * self.multiplier ** attempt)
+        if self.jitter:
+            u = (
+                random.Random(self.seed * 1_000_003 + attempt).random()
+                if self.seed is not None
+                else random.random()
+            )
+            d *= 1.0 + self.jitter * (2.0 * u - 1.0)
+        return max(0.0, d)
+
+    def backoff(self, attempt: int) -> None:
+        self.sleep(self.delay_s(attempt))
+
+
+# A missing/forbidden file is a caller bug, not NFS weather — never retried.
+_NON_TRANSIENT = (
+    FileNotFoundError,
+    PermissionError,
+    IsADirectoryError,
+    NotADirectoryError,
+)
+
+
+def transient_io(e: BaseException) -> bool:
+    """The default transience classifier: any OSError that is not a
+    deterministic filesystem refusal."""
+    return isinstance(e, OSError) and not isinstance(e, _NON_TRANSIENT)
+
+
+def retry_call(
+    fn: Callable[[], object],
+    *,
+    policy: RetryPolicy,
+    describe: str = "call",
+    transient: Callable[[BaseException], bool] = transient_io,
+    counter: str = "retry.io",
+):
+    """Run ``fn`` under ``policy``: transient failures back off and
+    retry, everything else (and the last attempt) raises."""
+    for attempt in range(max(1, policy.attempts)):
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 — classified below
+            if not transient(e) or attempt >= policy.attempts - 1:
+                raise
+            incr(counter)
+            log.warning(
+                "%s failed (%s: %s); retry %d/%d in %.3fs",
+                describe, type(e).__name__, e, attempt + 1,
+                policy.attempts - 1, policy.delay_s(attempt),
+            )
+            policy.backoff(attempt)
+    raise AssertionError("unreachable")
+
+
+_io_policy: Optional[RetryPolicy] = None
+_io_policy_lock = threading.Lock()
+
+
+def io_policy() -> RetryPolicy:
+    """The process-wide transient-file-I/O retry policy (guppi/fbh5/worker
+    reads).  Defaults from the environment (``BLIT_IO_RETRIES`` total
+    attempts, ``BLIT_IO_BACKOFF_S``, ``BLIT_IO_BACKOFF_MAX_S``); override
+    with :func:`set_io_policy` — e.g.
+    ``set_io_policy(config.io_retry_policy())``."""
+    global _io_policy
+    with _io_policy_lock:
+        if _io_policy is None:
+            _io_policy = RetryPolicy(
+                attempts=int(os.environ.get("BLIT_IO_RETRIES", 3)),
+                base_s=float(os.environ.get("BLIT_IO_BACKOFF_S", 0.05)),
+                max_s=float(os.environ.get("BLIT_IO_BACKOFF_MAX_S", 2.0)),
+            )
+        return _io_policy
+
+
+def set_io_policy(policy: Optional[RetryPolicy]) -> None:
+    """Install the process-wide I/O retry policy (``None`` resets to the
+    environment defaults)."""
+    global _io_policy
+    with _io_policy_lock:
+        _io_policy = policy
+
+
+def retry_io(fn: Callable[[], object], describe: str = "io"):
+    """Transient-I/O retry under the process-wide policy — the wrapper
+    every worker-side file read/write goes through."""
+    return retry_call(fn, policy=io_policy(), describe=describe)
+
+
+# -- circuit breaker --------------------------------------------------------
+
+
+class CircuitBreaker:
+    """Per-host failure circuit: ``threshold`` CONSECUTIVE failures trip it
+    ``open`` (the host is *degraded* — callers fail fast instead of
+    hammering it); after ``cooldown_s`` one probe call is allowed
+    (``half-open``), whose success re-closes the circuit and whose failure
+    re-opens it for another cooldown.  ``clock`` is injectable so tests
+    advance time instead of waiting it."""
+
+    def __init__(self, threshold: int = 3, cooldown_s: float = 60.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.threshold = max(1, threshold)
+        self.cooldown_s = cooldown_s
+        self.clock = clock
+        self._lock = threading.Lock()
+        self.state = "closed"
+        self.failures = 0  # consecutive
+        self.trips = 0
+        self._opened_at = 0.0
+        self._probing = False
+
+    def allow(self) -> bool:
+        """May a call be dispatched now?  (Consumes the half-open probe
+        slot when it grants one.)"""
+        with self._lock:
+            if self.state == "closed":
+                return True
+            if (
+                self.state == "open"
+                and self.clock() - self._opened_at >= self.cooldown_s
+            ):
+                self.state = "half-open"
+                self._probing = False
+            if self.state == "half-open" and not self._probing:
+                self._probing = True
+                return True
+            return False
+
+    def closed(self) -> bool:
+        """Non-consuming check: is the circuit fully closed?  (Retry loops
+        use this so a mid-loop check cannot eat the half-open probe.)"""
+        with self._lock:
+            return self.state == "closed"
+
+    def record_success(self) -> None:
+        with self._lock:
+            self.state = "closed"
+            self.failures = 0
+            self._probing = False
+
+    def record_failure(self) -> bool:
+        """Count a failure; returns True when THIS failure tripped the
+        circuit open (callers log/count the trip exactly once)."""
+        with self._lock:
+            self.failures += 1
+            if self.state == "half-open" or (
+                self.state == "closed" and self.failures >= self.threshold
+            ):
+                self.state = "open"
+                self._opened_at = self.clock()
+                self._probing = False
+                self.trips += 1
+                return True
+            return False
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "state": self.state,
+                "consecutive_failures": self.failures,
+                "trips": self.trips,
+            }

@@ -20,6 +20,7 @@ from typing import Dict, Optional, Tuple
 import h5py
 import numpy as np
 
+from blit import faults
 from blit.config import nfpc_from_foff
 from blit.io.bshuf import BITSHUFFLE_FILTER_ID
 
@@ -332,8 +333,16 @@ class _ChunkStream:
         if rows < self.chunks[0]:
             self._buf[rows:] = 0
         corner = (self.nsamps, 0, 0)
-        self._ds.resize(self.nsamps + rows, axis=0)
-        self._ds.id.write_direct_chunk(corner, bshuf.compress_chunk(self._buf))
+        payload = bshuf.compress_chunk(self._buf)
+
+        def _write():
+            # Idempotent under retry: resize targets an absolute size and
+            # the direct-chunk write lands at a fixed corner.
+            faults.fire("fbh5.write", key=self.path)
+            self._ds.resize(self.nsamps + rows, axis=0)
+            self._ds.id.write_direct_chunk(corner, payload)
+
+        faults.retry_io(_write, describe=f"fbh5 chunk write {self.path}")
         self.nsamps += rows
         self._buffered = 0
 
@@ -436,8 +445,13 @@ class FBH5Writer(_ChunkStream):
             )
         if not self._bitshuffle:
             k = slab.shape[0]
-            self._ds.resize(self.nsamps + k, axis=0)
-            self._ds[self.nsamps:] = slab
+
+            def _write():
+                # Absolute resize + fixed-offset assignment: safe to retry.
+                faults.fire("fbh5.write", key=self.path)
+                self._ds.resize(self.nsamps + k, axis=0)
+                self._ds[self.nsamps:] = slab
+            faults.retry_io(_write, describe=f"fbh5 write {self.path}")
             self.nsamps += k
             return
         self._buffer_slab(slab)
@@ -612,8 +626,12 @@ class ResumableFBH5Writer(_ChunkStream):
             )
         if not self._bitshuffle:
             k = slab.shape[0]
-            self._ds.resize(self.nsamps + k, axis=0)
-            self._ds[self.nsamps:] = slab
+
+            def _write():
+                faults.fire("fbh5.write", key=self.path)
+                self._ds.resize(self.nsamps + k, axis=0)
+                self._ds[self.nsamps:] = slab
+            faults.retry_io(_write, describe=f"fbh5 write {self.path}")
             self.nsamps += k
             self._checkpoint(self.nsamps)
             return

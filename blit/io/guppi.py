@@ -25,6 +25,8 @@ from typing import Dict, Iterator, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
+from blit import faults
+
 log = logging.getLogger("blit.guppi")
 
 CARD_LEN = 80
@@ -174,18 +176,29 @@ class GuppiRaw(_BlockStream):
             self.native = have
         else:
             self.native = False
-        with open(path, "rb") as f:
-            size = os.path.getsize(path)
-            while True:
-                try:
-                    hdr, off = read_raw_header(f)
-                except EOFError:
-                    break
-                if off + hdr["BLOCSIZE"] > size:
-                    break  # truncated trailing block
-                self.headers.append(hdr)
-                self._data_offsets.append(off)
-                f.seek(hdr["BLOCSIZE"], os.SEEK_CUR)
+        def _scan():
+            # Retried as a unit: a transient failure mid-scan must not
+            # leave a half-indexed file behind (faults.retry_io classifies
+            # — FileNotFoundError etc. stay immediate).
+            faults.fire("guppi.open", key=path)
+            headers, offsets = [], []
+            with open(path, "rb") as f:
+                size = os.path.getsize(path)
+                while True:
+                    try:
+                        hdr, off = read_raw_header(f)
+                    except EOFError:
+                        break
+                    if off + hdr["BLOCSIZE"] > size:
+                        break  # truncated trailing block
+                    headers.append(hdr)
+                    offsets.append(off)
+                    f.seek(hdr["BLOCSIZE"], os.SEEK_CUR)
+            return headers, offsets
+
+        self.headers, self._data_offsets = faults.retry_io(
+            _scan, describe=f"guppi open {path}"
+        )
 
     @property
     def nblocks(self) -> int:
@@ -211,19 +224,33 @@ class GuppiRaw(_BlockStream):
         memmap view (pages in on consumption, single-threaded)."""
         nchan, ntime, npol = self._block_geometry(i)
         shape = (nchan, ntime, npol, 2)
-        if self.native:
-            from blit.io.native import guppi_pread
 
-            nbytes = nchan * ntime * npol * 2
-            buf = guppi_pread(self.path, self._data_offsets[i], nbytes)
-            return buf.view(np.int8).reshape(shape)
-        return np.memmap(
-            self.path,
-            dtype=np.int8,
-            mode="r",
-            offset=self._data_offsets[i],
-            shape=shape,
-        )
+        def _read():
+            act = faults.fire("guppi.read", key=self.path)
+            if self.native:
+                from blit.io.native import guppi_pread
+
+                nbytes = nchan * ntime * npol * 2
+                buf = guppi_pread(self.path, self._data_offsets[i], nbytes)
+                arr = buf.view(np.int8).reshape(shape)
+            else:
+                arr = np.memmap(
+                    self.path,
+                    dtype=np.int8,
+                    mode="r",
+                    offset=self._data_offsets[i],
+                    shape=shape,
+                )
+            if act is not None:  # destructive drills apply here too
+                if act.mode == "truncate":
+                    arr = arr[:, : max(
+                        0, ntime - (act.amount or max(1, ntime // 2)))]
+                elif act.mode == "corrupt":
+                    arr = np.array(arr)  # memmaps are read-only views
+                    arr[0] ^= 0x55
+            return arr
+
+        return faults.retry_io(_read, describe=f"guppi read {self.path}")
 
     def read_block_into(
         self, i: int, dst: np.ndarray, t0: int = 0, ntime_keep: int = -1
@@ -235,8 +262,15 @@ class GuppiRaw(_BlockStream):
         ``dst``: int8 ``(nchan, >=ntime_keep, npol, 2)`` with C-contiguous
         rows (a time-slice view of a C-contiguous ring buffer qualifies).
         ``ntime_keep=-1`` means through the end of the block.  Returns the
-        samples written.  Uses the native strided pread when built, else a
-        memmap copy.
+        samples written — callers MUST treat a short return as a hard
+        failure (a truncated recording); it is never silently padded.
+        Uses the native strided pread when built, else a memmap copy.
+
+        Transient ``OSError``\\ s retry under ``blit.faults.io_policy()``;
+        the ``guppi.read`` injection point fires inside the retry loop, so
+        injected transients exercise exactly the production recovery path
+        (``truncate`` rules shorten the read, ``corrupt`` rules bit-flip
+        the delivered frame).
         """
         nchan, ntime, npol = self._block_geometry(i)
         if ntime_keep < 0:
@@ -251,28 +285,39 @@ class GuppiRaw(_BlockStream):
         if ntime_keep == 0:
             return 0
         samp_bytes = npol * 2
-        if self.native and dst[0].flags.c_contiguous:
-            from blit.io.native import guppi_pread_strided
 
-            guppi_pread_strided(
-                self.path,
-                self._data_offsets[i] + t0 * samp_bytes,
-                nchan,
-                ntime_keep * samp_bytes,
-                ntime * samp_bytes,
-                dst,
-                dst.strides[0],
-            )
-            return ntime_keep
-        mm = np.memmap(
-            self.path,
-            dtype=np.int8,
-            mode="r",
-            offset=self._data_offsets[i],
-            shape=(nchan, ntime, npol, 2),
-        )
-        dst[:, :ntime_keep] = mm[:, t0 : t0 + ntime_keep]
-        return ntime_keep
+        def _read() -> int:
+            act = faults.fire("guppi.read", key=self.path)
+            nt = ntime_keep
+            if act is not None and act.mode == "truncate":
+                nt = max(0, nt - (act.amount or max(1, nt // 2)))
+            if nt:
+                if self.native and dst[0].flags.c_contiguous:
+                    from blit.io.native import guppi_pread_strided
+
+                    guppi_pread_strided(
+                        self.path,
+                        self._data_offsets[i] + t0 * samp_bytes,
+                        nchan,
+                        nt * samp_bytes,
+                        ntime * samp_bytes,
+                        dst,
+                        dst.strides[0],
+                    )
+                else:
+                    mm = np.memmap(
+                        self.path,
+                        dtype=np.int8,
+                        mode="r",
+                        offset=self._data_offsets[i],
+                        shape=(nchan, ntime, npol, 2),
+                    )
+                    dst[:, :nt] = mm[:, t0 : t0 + nt]
+                if act is not None and act.mode == "corrupt":
+                    dst[0, :nt] ^= 0x55
+            return nt
+
+        return faults.retry_io(_read, describe=f"guppi read {self.path}")
 
     def read_block_complex(self, i: int) -> np.ndarray:
         """Block ``i`` as complex64, shaped ``(obsnchan, ntime, npol)``."""

@@ -60,7 +60,16 @@ class Timeline:
             if byte_free:
                 s.byte_free = True
 
-    def report(self) -> Dict[str, Dict]:
+    def count(self, name: str, n: int = 1) -> None:
+        """Record a byte-free event counter as a stage (``calls`` carries
+        the count) — retry/mask/degradation events land here so they show
+        up in :meth:`report` and in the per-window :meth:`since` tables
+        (ISSUE 2: a degraded run must say so in its report)."""
+        s = self.stages[name]
+        s.calls += n
+        s.byte_free = True
+
+    def report(self, include_faults: bool = False) -> Dict[str, Dict]:
         out = {}
         # list(): producer threads (the window feeds) insert stage keys
         # concurrently with consumer-side reporting — never iterate the
@@ -72,6 +81,16 @@ class Timeline:
             if v.byte_free:
                 row["byte_free"] = True
             out[k] = row
+        if include_faults:
+            # Process-wide failure/recovery totals (blit/faults.py):
+            # retry.io / retry.remote / mask.antenna / breaker.trip /
+            # fault.<point>.<mode>.  Global (not per-timeline) by design —
+            # retries deep inside the I/O layer have no timeline in hand.
+            from blit import faults
+
+            c = faults.counters()
+            if c:
+                out["faults"] = c
         return out
 
     def snapshot(self) -> Dict[str, tuple]:

@@ -37,13 +37,17 @@ HLOs (DESIGN.md §1).
 
 from __future__ import annotations
 
+import logging
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from blit import faults
 from blit.io.guppi import open_raw
 from blit.observability import Timeline
 from blit.parallel.scan import _gapless, _gather_int64, _kept_samples
+
+log = logging.getLogger("blit.antenna")
 
 Planar = Tuple["object", "object"]
 
@@ -380,11 +384,12 @@ class Window:
     exactly like an unreleased :class:`blit.pipeline.RawReducer` chunk.
     """
 
-    __slots__ = ("index", "start", "ntime", "frames", "arrays", "_rot",
-                 "_slot")
+    __slots__ = ("index", "start", "ntime", "frames", "arrays", "masked",
+                 "_rot", "_slot")
 
     def __init__(self, index: int, start: int, ntime: int,
-                 frames: Optional[int], arrays: Planar, rot, slot: int):
+                 frames: Optional[int], arrays: Planar, rot, slot: int,
+                 masked: Tuple[int, ...] = ()):
         self.index = index    # window ordinal in the stream
         self.start = start    # sample (AntennaStream) / frame (Correlator-
         #                       Stream, per band segment) offset
@@ -392,6 +397,8 @@ class Window:
         self.frames = frames  # F-engine frames this window contributes
         #                       (CorrelatorStream only)
         self.arrays = arrays
+        self.masked = masked  # antennas zero-weighted in this window
+        #                       (degraded continuation; see stream docs)
         self._rot = rot
         self._slot = slot
 
@@ -402,7 +409,48 @@ class Window:
             rot.release(self._slot)
 
 
-class AntennaStream:
+class _DegradedContinuation:
+    """Shared degraded-antenna state for the windowed streams (ISSUE 2
+    tentpole): with ``on_antenna_error="mask"`` a HARD mid-stream antenna
+    failure (truncated recording, retries exhausted, wedged mount
+    surfacing as an error) zero-weights that antenna from the failing
+    window onward instead of aborting the scan.  Zeroed planes contribute
+    exactly nothing to the linear beam sums and baseline cross-products,
+    so the collectives need no math changes; the flag rides every
+    subsequent :class:`Window` (``masked``), the stream's
+    ``masked_antennas`` set, the product header
+    (``_masked_antennas``) and the ``antenna.masked`` timeline counter,
+    so a degraded run SAYS so in its report.
+
+    Masking is per-process: on multi-process pods each process masks the
+    antennas whose files it reads; processes that never read the failed
+    recording keep their (already-agreed) span untouched."""
+
+    def _init_degraded(self, on_antenna_error: str,
+                       stall_timeout_s: Optional[float]) -> None:
+        if on_antenna_error not in ("raise", "mask"):
+            raise ValueError(
+                f"on_antenna_error must be 'raise' or 'mask', "
+                f"got {on_antenna_error!r}"
+            )
+        self.on_antenna_error = on_antenna_error
+        self.stall_timeout_s = stall_timeout_s
+        self.masked_antennas: set = set()
+
+    def _mask(self, a: int, err: BaseException) -> None:
+        if a not in self.masked_antennas:
+            self.masked_antennas.add(a)
+            self.header["_masked_antennas"] = sorted(self.masked_antennas)
+            self.timeline.count("antenna.masked")
+            faults.incr("mask.antenna")
+            log.warning(
+                "antenna %d hard-failed mid-stream (%s: %s); masking it "
+                "(zero weight) and continuing degraded",
+                a, type(err).__name__, err,
+            )
+
+
+class AntennaStream(_DegradedContinuation):
     """Windowed, double-buffered feed of per-antenna RAW recordings onto
     the beamform layout — the streaming twin of :func:`load_antennas_mesh`
     (module docstring: the ``RawReducer`` rotation applied to the
@@ -415,6 +463,14 @@ class AntennaStream:
     timings land in ``timeline``: ``ingest`` (RAW file bytes read),
     ``pack`` (dequant/pack into the planar host buffers), ``transfer``
     (sharded ``device_put``, planar bytes moved).
+
+    Fault tolerance (ISSUE 2): transient read errors already retry inside
+    :meth:`blit.io.guppi.GuppiRaw.read_block_into` (invisible here beyond
+    the ``retry.io`` counter); ``on_antenna_error="mask"`` turns HARD
+    per-antenna failures into degraded continuation
+    (:class:`_DegradedContinuation`) instead of a stream abort;
+    ``stall_timeout_s`` arms the rotation's producer-progress watchdog so
+    a wedged read bounds the hang.
     """
 
     def __init__(
@@ -430,9 +486,12 @@ class AntennaStream:
         layout: str = "antenna",
         prefetch_depth: int = 2,
         timeline: Optional[Timeline] = None,
+        on_antenna_error: str = "raise",
+        stall_timeout_s: Optional[float] = None,
     ):
         if window_samples <= 0:
             raise ValueError(f"window_samples must be > 0, got {window_samples}")
+        self._init_degraded(on_antenna_error, stall_timeout_s)
         self.mesh = mesh
         self.axis = axis
         self.layout = layout
@@ -491,9 +550,22 @@ class AntennaStream:
             }
         return self._store[slot]
 
+    def _zero_antenna(self, br, bi, j: int, wt: int) -> None:
+        """Zero-weight one local antenna's planes for this window (the
+        masked-antenna contribution to every linear collective is then
+        exactly zero)."""
+        if self.layout == "chan":
+            br[:, j, :, :wt] = 0
+            bi[:, j, :, :wt] = 0
+        else:
+            br[j, :, :wt] = 0
+            bi[j, :, :wt] = 0
+
     def _fill(self, rot) -> None:
         """Producer thread: read + dequant each window into its slot's
-        planar buffers (one antenna-window of int8 scratch at a time)."""
+        planar buffers (one antenna-window of int8 scratch at a time).
+        Hard per-antenna failures mask-and-continue under
+        ``on_antenna_error="mask"`` (class docstring)."""
         tl = self.timeline
         scratch = np.empty(
             (self.nchan, self.window_samples, self.npol, 2), np.int8
@@ -507,30 +579,43 @@ class AntennaStream:
             for d, lo in self.plan:
                 br, bi = store[d]
                 for j, a in enumerate(range(lo, lo + self.per)):
-                    with tl.stage("ingest", nbytes=raw_bytes):
-                        v = _gapless(
-                            self._raws[a], wt,
-                            skip=self.start_sample + w0, out=scratch,
+                    if a in self.masked_antennas:
+                        self._zero_antenna(br, bi, j, wt)
+                        continue
+                    try:
+                        faults.fire(
+                            "antenna.produce", key=self._raws[a].path
                         )
-                    if v.shape[1] < wt:
-                        raise ValueError(
-                            f"{self._raws[a].path}: {v.shape[1]} samples "
-                            f"from offset {self.start_sample + w0}, need {wt}"
-                        )
-                    with tl.stage(
-                        "pack",
-                        nbytes=2 * self.nchan * wt * self.npol
-                        * self.dev_dtype.itemsize,
-                    ):
-                        if self.layout == "chan":
-                            br[:, j, :, :wt] = np.transpose(
-                                v[..., 0], (0, 2, 1))
-                            bi[:, j, :, :wt] = np.transpose(
-                                v[..., 1], (0, 2, 1))
-                        else:
-                            br[j, :, :wt] = v[..., 0]
-                            bi[j, :, :wt] = v[..., 1]
-            rot.emit(slot, (w, w0, wt))
+                        with tl.stage("ingest", nbytes=raw_bytes):
+                            v = _gapless(
+                                self._raws[a], wt,
+                                skip=self.start_sample + w0, out=scratch,
+                            )
+                        if v.shape[1] < wt:
+                            raise ValueError(
+                                f"{self._raws[a].path}: {v.shape[1]} "
+                                f"samples from offset "
+                                f"{self.start_sample + w0}, need {wt}"
+                            )
+                        with tl.stage(
+                            "pack",
+                            nbytes=2 * self.nchan * wt * self.npol
+                            * self.dev_dtype.itemsize,
+                        ):
+                            if self.layout == "chan":
+                                br[:, j, :, :wt] = np.transpose(
+                                    v[..., 0], (0, 2, 1))
+                                bi[:, j, :, :wt] = np.transpose(
+                                    v[..., 1], (0, 2, 1))
+                            else:
+                                br[j, :, :wt] = v[..., 0]
+                                bi[j, :, :wt] = v[..., 1]
+                    except Exception as e:  # noqa: BLE001 — classified
+                        if self.on_antenna_error != "mask":
+                            raise
+                        self._mask(a, e)
+                        self._zero_antenna(br, bi, j, wt)
+            rot.emit(slot, (w, w0, wt, tuple(sorted(self.masked_antennas))))
 
     def __iter__(self) -> Iterator[Window]:
         import jax
@@ -539,10 +624,11 @@ class AntennaStream:
 
         tl = self.timeline
         rot = BufferRotation(
-            self.prefetch_depth, self._fill, name="blit-antenna-feed"
+            self.prefetch_depth, self._fill, name="blit-antenna-feed",
+            stall_timeout_s=self.stall_timeout_s,
         )
         try:
-            for slot, (w, w0, wt) in rot.slots():
+            for slot, (w, w0, wt, masked) in rot.slots():
                 store = self._store[slot]
                 if self.layout == "chan":
                     global_shape = (self.nchan, self.nant, self.npol, wt)
@@ -572,13 +658,14 @@ class AntennaStream:
                 # slot is only safe to refill once the compute that read
                 # this window has synchronized.
                 yield Window(
-                    w, self.start_sample + w0, wt, None, (vr, vi), rot, slot
+                    w, self.start_sample + w0, wt, None, (vr, vi), rot,
+                    slot, masked=masked,
                 )
         finally:
             rot.close()
 
 
-class CorrelatorStream:
+class CorrelatorStream(_DegradedContinuation):
     """Windowed, double-buffered feed onto the FX-correlator layout — the
     streaming twin of :func:`load_correlator_mesh`.
 
@@ -611,9 +698,12 @@ class CorrelatorStream:
         dtype="float32",
         prefetch_depth: int = 2,
         timeline: Optional[Timeline] = None,
+        on_antenna_error: str = "raise",
+        stall_timeout_s: Optional[float] = None,
     ):
         if window_frames <= 0:
             raise ValueError(f"window_frames must be > 0, got {window_frames}")
+        self._init_degraded(on_antenna_error, stall_timeout_s)
         self.mesh = mesh
         self.nfft, self.ntap = nfft, ntap
         self.window_frames = window_frames
@@ -729,25 +819,50 @@ class CorrelatorStream:
                 row_base = self.start_sample + b * self.seg
                 raw_bytes = self.nchan * fresh * self.npol * 2
                 for a in range(self.nant):
-                    with tl.stage("ingest", nbytes=raw_bytes):
-                        v = _gapless(
-                            self._raws[a], fresh,
-                            skip=row_base + f0 * nfft + fresh0, out=scratch,
+                    if a in self.masked_antennas:
+                        # Whole window extent, PFB tail included — a
+                        # masked antenna's stale tail must not leak.
+                        br[a, :, :used] = 0
+                        bi[a, :, :used] = 0
+                        continue
+                    try:
+                        faults.fire(
+                            "antenna.produce", key=self._raws[a].path
                         )
-                    if v.shape[1] < fresh:
-                        raise ValueError(
-                            f"{self._raws[a].path}: {v.shape[1]} samples "
-                            f"from offset {row_base + f0 * nfft + fresh0}, "
-                            f"need {fresh}"
-                        )
-                    with tl.stage(
-                        "pack",
-                        nbytes=2 * self.nchan * fresh * self.npol
-                        * self.dev_dtype.itemsize,
-                    ):
-                        br[a, :, fresh0:used] = v[..., 0]
-                        bi[a, :, fresh0:used] = v[..., 1]
-            rot.emit(slot, (w, f0, fw, used))
+                        with tl.stage("ingest", nbytes=raw_bytes):
+                            v = _gapless(
+                                self._raws[a], fresh,
+                                skip=row_base + f0 * nfft + fresh0,
+                                out=scratch,
+                            )
+                        if v.shape[1] < fresh:
+                            raise ValueError(
+                                f"{self._raws[a].path}: {v.shape[1]} "
+                                f"samples from offset "
+                                f"{row_base + f0 * nfft + fresh0}, "
+                                f"need {fresh}"
+                            )
+                        with tl.stage(
+                            "pack",
+                            nbytes=2 * self.nchan * fresh * self.npol
+                            * self.dev_dtype.itemsize,
+                        ):
+                            br[a, :, fresh0:used] = v[..., 0]
+                            bi[a, :, fresh0:used] = v[..., 1]
+                    except Exception as e:  # noqa: BLE001 — classified
+                        if self.on_antenna_error != "mask":
+                            raise
+                        self._mask(a, e)
+                        # The window is masked WHOLE for this antenna,
+                        # across every band row of the current slot (some
+                        # rows were already packed with its pre-failure
+                        # bytes this window).
+                        for bb in sorted(self._by_band):
+                            bbr, bbi = store[bb]
+                            bbr[a, :, :used] = 0
+                            bbi[a, :, :used] = 0
+            rot.emit(slot, (w, f0, fw, used,
+                            tuple(sorted(self.masked_antennas))))
             prev, prev_used = store, used
 
     def __iter__(self) -> Iterator[Window]:
@@ -757,10 +872,11 @@ class CorrelatorStream:
 
         tl = self.timeline
         rot = BufferRotation(
-            self.prefetch_depth, self._fill, name="blit-correlator-feed"
+            self.prefetch_depth, self._fill, name="blit-correlator-feed",
+            stall_timeout_s=self.stall_timeout_s,
         )
         try:
-            for slot, (w, f0, fw, used) in rot.slots():
+            for slot, (w, f0, fw, used, masked) in rot.slots():
                 store = self._store[slot]
                 global_shape = (
                     self.nant, self.nchan, self.nband * used, self.npol
@@ -792,7 +908,8 @@ class CorrelatorStream:
                 # previous slot, which the rotation's refill-after-release
                 # rule already covers.
                 yield Window(
-                    w, f0, self.nband * used, fw, (vr, vi), rot, slot
+                    w, f0, self.nband * used, fw, (vr, vi), rot, slot,
+                    masked=masked,
                 )
         finally:
             rot.close()

@@ -348,6 +348,11 @@ def beamform_stream(
                 f"whole number of nint={nint} integrations; choose "
                 "window_samples (and span) divisible by nint"
             )
+        if win.masked:
+            # Degraded continuation (feed masked a failed antenna): the
+            # accumulated powers carry its zero weight; flag it in the
+            # driver's per-window stage tables too.
+            tl.count("masked_antennas", len(win.masked))
         with tl.stage("dispatch", byte_free=True):
             out = beamform(
                 win.arrays, weights, mesh=mesh, axis=axis, nint=nint,
@@ -386,6 +391,8 @@ def beamform_accumulate(
     prev = None
     add = _jax.jit(lambda a, p: a + p, donate_argnums=0)
     for win in feed:
+        if win.masked:
+            tl.count("masked_antennas", len(win.masked))
         if prev is not None:
             # Lag-1: wait for the previous window's fold (its power output
             # implies its input was consumed), then recycle its slot.

@@ -423,6 +423,12 @@ def correlate_stream(
     accr = acci = None
     prev = None
     for win in feed:
+        if win.masked:
+            # Degraded continuation: the band-sharded accumulator folds
+            # this window with the failed antenna zero-weighted; the flag
+            # rides the driver's stage tables and the feed's metadata
+            # (``masked_antennas`` / header ``_masked_antennas``).
+            tl.count("masked_antennas", len(win.masked))
         vr, vi = win.arrays
         if accr is not None:
             # Lag-1 sync: wait for window w-1's fold only now — the feed

@@ -78,7 +78,10 @@ def init_multihost(
             if "once" in msg:
                 pass
             elif (
-                "before any jax calls" in msg
+                # jax <= 0.5 says "before any JAX calls"; newer jax says
+                # "before any JAX computations are executed" — match the
+                # shared prefix so a message tweak cannot re-break this.
+                "before any jax" in msg
                 and coordinator_address is None
                 and not _cluster_env_hints()
             ):

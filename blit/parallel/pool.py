@@ -24,11 +24,14 @@ Differences from the reference, by design (SURVEY.md §5 "Failure detection"):
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from concurrent.futures import Future, ProcessPoolExecutor, ThreadPoolExecutor
+from concurrent.futures import TimeoutError as _FutTimeout
 from dataclasses import dataclass, field
-from typing import Any, Callable, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
+from blit import faults
 from blit.config import DEFAULT, SiteConfig
 
 log = logging.getLogger("blit.pool")
@@ -62,6 +65,10 @@ class _Worker:
     wid: int
     host: str
     remote: Optional[object] = None  # RemoteWorker for backend="remote"
+    # Per-host failure circuit (consulted on the remote call path only):
+    # repeated AgentDied/CallTimeout trips the host into "degraded" and
+    # calls fail fast instead of hammering it (ISSUE 2 tentpole).
+    breaker: Optional[faults.CircuitBreaker] = None
 
 
 class WorkerPool:
@@ -100,8 +107,15 @@ class WorkerPool:
         # Worker ids start at 1; id 0 is "the main process" by convention,
         # mirroring Distributed.jl's pid-1 master.
         self.workers: List[_Worker] = [
-            _Worker(i + 1, h) for i, h in enumerate(hosts)
+            _Worker(i + 1, h, breaker=faults.CircuitBreaker(
+                config.breaker_threshold, config.breaker_cooldown_s))
+            for i, h in enumerate(hosts)
         ]
+        # Remote-call re-dispatch policy (AgentDied/CallTimeout retries
+        # through the existing agent respawn; seeded jitter, injectable
+        # sleep — see blit/faults.py).  The policy is the ONE source of
+        # truth for both the attempt count and the backoff curve.
+        self.retry_policy = config.call_retry_policy()
         self._exec = None
         if backend in ("thread", "remote"):
             self._exec = ThreadPoolExecutor(
@@ -135,13 +149,69 @@ class WorkerPool:
     def __len__(self):
         return len(self.workers)
 
+    def health(self) -> List[Dict[str, object]]:
+        """Per-worker circuit state for the run report: a degraded run
+        must SAY so (``state == "open"`` means the host is degraded and
+        calls fail fast until the cooldown probe re-closes it)."""
+        return [
+            {"worker": w.wid, "host": w.host, **w.breaker.snapshot()}
+            for w in self.workers
+        ]
+
     # -- execution --------------------------------------------------------
+    def _remote_call(self, w: _Worker, fn: Callable, /, *args, **kw):
+        """One remote dispatch under the recovery policy: retry transient
+        worker-loss failures (``AgentDied``/``CallTimeout`` — the next
+        ``RemoteWorker.call`` respawns the agent) with jittered backoff,
+        feeding the per-host circuit breaker.  A tripped breaker fails
+        fast with ``RemoteError(etype="HostDegraded")`` until its cooldown
+        probe — repeated failures must degrade the host, not hammer it."""
+        from blit.parallel.remote import RemoteError
+
+        br = w.breaker
+        if not br.allow():
+            faults.incr("breaker.fastfail")
+            raise RemoteError(
+                w.host, "HostDegraded",
+                f"circuit open after {br.failures} consecutive failures; "
+                f"next probe within {br.cooldown_s}s", "",
+            )
+        attempts = max(1, self.retry_policy.attempts)
+        for attempt in range(attempts):
+            try:
+                result = w.remote.call(fn, *args, **kw)
+            except RemoteError as e:
+                if br.record_failure():
+                    faults.incr("breaker.trip")
+                    log.error(
+                        "worker %d (%s) tripped its circuit breaker after "
+                        "%d consecutive failures (%s); host degraded for "
+                        "%.0fs", w.wid, w.host, br.failures, e.etype,
+                        br.cooldown_s,
+                    )
+                transient = e.etype in ("AgentDied", "CallTimeout")
+                # br.closed() is the non-consuming check: once the breaker
+                # tripped mid-loop, stop re-dispatching to the sick host.
+                if (not transient or attempt == attempts - 1
+                        or not br.closed()):
+                    raise
+                faults.incr("retry.remote")
+                log.warning(
+                    "worker %d (%s): %s; re-dispatch %d/%d after backoff",
+                    w.wid, w.host, e.etype, attempt + 1, attempts - 1,
+                )
+                self.retry_policy.backoff(attempt)
+            else:
+                br.record_success()
+                return result
+        raise AssertionError("unreachable")
+
     def _submit(self, worker: _Worker, fn: Callable, /, *args, **kw) -> Future:
         """Dispatch one call for ``worker``.  Shared-filesystem backends run
         it anywhere; the remote backend routes it to that worker's host —
         the reference's ``@spawnat worker`` placement (src/gbt.jl:54-57)."""
         if worker.remote is not None:
-            return self._exec.submit(worker.remote.call, fn, *args, **kw)
+            return self._exec.submit(self._remote_call, worker, fn, *args, **kw)
         if self._exec is None:
             f: Future = Future()
             try:
@@ -188,15 +258,33 @@ class WorkerPool:
         ]
         deadline = None if timeout is None else time.monotonic() + timeout
         results: List[Any] = []
-        for wid, fut in zip(wids, futures):
+        for i, (wid, fut) in enumerate(zip(wids, futures)):
             try:
                 results.append(fut.result(timeout=_remaining(deadline)))
             except Exception as e:  # noqa: BLE001
+                if isinstance(e, _FutTimeout) and not fut.done():
+                    # A pending future past the deadline is OUR fan-in
+                    # timeout: normalize to the builtin with the worker
+                    # named (on Py < 3.11 concurrent.futures.TimeoutError
+                    # is not even the builtin; on 3.11+ it is, but arrives
+                    # message-less).  A TimeoutError RAISED BY the worker
+                    # fn leaves fut.done() true and passes through as-is.
+                    e = TimeoutError(
+                        f"worker {wid} ({self.host_of(wid)}): fan-in "
+                        f"deadline of {timeout}s exceeded"
+                    )
                 if on_error == "capture":
                     log.warning("worker %d (%s) failed: %s", wid, self.host_of(wid), e)
                     results.append(WorkerError(wid, self.host_of(wid), e))
                 else:
-                    raise
+                    # Aborting the fan-in must not leak the rest of the
+                    # broadcast as orphaned background work: cancel every
+                    # future the executor has not started yet (started
+                    # ones run to completion — Python offers no safe
+                    # cancel; the timed-out fut itself is in this range).
+                    for later in futures[i:]:
+                        later.cancel()
+                    raise e
         return results
 
     def broadcast(
@@ -215,15 +303,22 @@ class WorkerPool:
             futures.append(self._submit(w, fn, **kw))
         deadline = None if timeout is None else time.monotonic() + timeout
         results = []
-        for w, fut in zip(self.workers, futures):
+        for i, (w, fut) in enumerate(zip(self.workers, futures)):
             try:
                 results.append(fut.result(timeout=_remaining(deadline)))
             except Exception as e:  # noqa: BLE001
+                if isinstance(e, _FutTimeout) and not fut.done():
+                    e = TimeoutError(  # as in run_on: one catchable type
+                        f"worker {w.wid} ({w.host}): fan-in deadline of "
+                        f"{timeout}s exceeded"
+                    )
                 if on_error == "capture":
                     log.warning("worker %d (%s) failed: %s", w.wid, w.host, e)
                     results.append(WorkerError(w.wid, w.host, e))
                 else:
-                    raise
+                    for later in futures[i:]:  # as in run_on: no orphans
+                        later.cancel()
+                    raise e
         return results
 
     def shutdown(self):
@@ -247,6 +342,10 @@ class WorkerPool:
 
 
 _current: Optional[WorkerPool] = None
+# Guards the read-modify-write on _current: two racing setup_workers calls
+# must get the SAME pool, not each build (and one leak) a full pool of
+# threads/agents (ISSUE 2 satellite).
+_current_lock = threading.Lock()
 
 
 def setup_workers(
@@ -254,19 +353,22 @@ def setup_workers(
     backend: Optional[str] = None,
     config: SiteConfig = DEFAULT,
 ) -> WorkerPool:
-    """Create (or return) the process-wide worker pool.
+    """Create (or return) the process-wide worker pool.  Thread-safe.
 
     Reference: ``GBT.setupworkers`` (src/gbt.jl:12-46).  Where the reference
     refuses to run twice and returns an *empty* pid list, blit returns the
     live pool (the documented fix for that wart, SURVEY.md §2.1)."""
     global _current
-    if _current is not None:
-        log.warning("workers already set up; returning the live pool")
+    with _current_lock:
+        if _current is not None:
+            log.warning("workers already set up; returning the live pool")
+            return _current
+        if hosts is None:
+            hosts = config.hosts
+        _current = WorkerPool(
+            hosts, backend=backend or config.backend, config=config
+        )
         return _current
-    if hosts is None:
-        hosts = config.hosts
-    _current = WorkerPool(hosts, backend=backend or config.backend, config=config)
-    return _current
 
 
 def current_pool() -> Optional[WorkerPool]:
@@ -274,8 +376,10 @@ def current_pool() -> Optional[WorkerPool]:
 
 
 def reset_pool():
-    """Tear down the process-wide pool (tests; elastic re-spawn)."""
+    """Tear down the process-wide pool (tests; elastic re-spawn).
+    Thread-safe; the (possibly slow) shutdown happens outside the lock."""
     global _current
-    if _current is not None:
-        _current.shutdown()
-        _current = None
+    with _current_lock:
+        pool, _current = _current, None
+    if pool is not None:
+        pool.shutdown()

@@ -24,6 +24,7 @@ import threading
 import time
 from typing import Callable, List, Optional, Sequence
 
+from blit import faults
 from blit.agent import MAGIC, _SAFE_GLOBALS_RESPONSE, read_msg, write_msg
 
 log = logging.getLogger("blit.remote")
@@ -309,6 +310,18 @@ class RemoteWorker:
         """Invoke ``fn`` (a blit callable) on the remote host, bounded by
         ``call_timeout``."""
         fn_path = f"{fn.__module__}.{fn.__qualname__}"
+        try:
+            # Transport-level injection point: a "fail" rule here looks to
+            # the pool exactly like the agent dying mid-call (the retry /
+            # circuit-breaker path); a "delay" rule models a slow dispatch
+            # (it runs BEFORE the _transact watchdog is armed, so it can
+            # never fire call_timeout — drill CallTimeout with a wedged
+            # agent instead, tests/_wedged_agent.py).
+            faults.fire("remote.call", key=self.host)
+        except Exception as e:  # noqa: BLE001 — injected
+            raise RemoteError(
+                self.host, "AgentDied", f"injected fault: {e}", ""
+            ) from e
         with self._lock:
             proc = self._ensure()
             reply = self._transact(

@@ -79,10 +79,16 @@ def _gapless(
             to_skip -= kept
             continue
         nt = min(kept - to_skip, total - filled)
-        raw.read_block_into(i, out[:, filled:], t0=to_skip, ntime_keep=nt)
+        got = raw.read_block_into(i, out[:, filled:], t0=to_skip, ntime_keep=nt)
         to_skip = 0
-        filled += nt
-    return out
+        filled += got
+        if got < nt:
+            # Short read (truncated recording, injected truncate fault):
+            # return what actually landed — every caller length-checks the
+            # result, so the shortfall surfaces as a hard error there
+            # instead of shipping a stale-byte tail into the collectives.
+            break
+    return out[:, :filled]
 
 
 # Per-player markers riding the pod-wide sample-count agreement.  ERR < UNFED

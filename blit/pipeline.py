@@ -35,6 +35,7 @@ import logging
 import os
 import queue
 import threading
+import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple, Union
@@ -117,10 +118,17 @@ class BufferRotation:
     - A slot is only refilled after the consumer released it; concurrent
       READS of an emitted slot (e.g. copying a filter-state tail into the
       next slot) are safe.
+    - ``stall_timeout_s`` arms a producer-progress watchdog: a live
+      producer that neither acquires nor emits for that long (a wedged
+      NFS read, a hung decoder) raises in the consumer instead of
+      hanging the whole run.  Back-pressure waits count as progress
+      (the consumer is the slow side there, not the producer).
     """
 
-    def __init__(self, nslots: int, fill, *, name: str = "blit-feed"):
+    def __init__(self, nslots: int, fill, *, name: str = "blit-feed",
+                 stall_timeout_s: Optional[float] = None):
         self.nslots = max(2, nslots)
+        self.stall_timeout_s = stall_timeout_s
         self._free: "queue.Queue[int]" = queue.Queue()
         for j in range(self.nslots):
             self._free.put(j)
@@ -132,6 +140,7 @@ class BufferRotation:
         )
         self._started = False
         self._held = 0  # slots yielded to the consumer, not yet released
+        self._beat = time.monotonic()  # last producer progress
 
     def _run(self) -> None:
         try:
@@ -145,12 +154,17 @@ class BufferRotation:
         """Next free slot index; ``None`` once the consumer is gone."""
         while not self._stop.is_set():
             try:
-                return self._free.get(timeout=0.2)
+                slot = self._free.get(timeout=0.2)
             except queue.Empty:
+                # Back-pressure from the consumer is not a producer stall.
+                self._beat = time.monotonic()
                 continue
+            self._beat = time.monotonic()
+            return slot
         return None
 
     def emit(self, slot: int, payload) -> None:
+        self._beat = time.monotonic()
         self._filled.put((slot, payload))
 
     # -- consumer side ----------------------------------------------------
@@ -163,12 +177,16 @@ class BufferRotation:
         on first use; re-raises producer exceptions.  A consumer that holds
         every slot unreleased while asking for more gets a loud error, not
         a silent deadlock (the producer can never fill another slot)."""
+        self._beat = time.monotonic()
         self._thread.start()
         self._started = True
+        poll = 0.5
+        if self.stall_timeout_s is not None:
+            poll = min(poll, max(0.05, self.stall_timeout_s / 2))
         try:
             while True:
                 try:
-                    item = self._filled.get(timeout=0.5)
+                    item = self._filled.get(timeout=poll)
                 except queue.Empty:
                     if self._held >= self.nslots:
                         raise RuntimeError(
@@ -176,6 +194,18 @@ class BufferRotation:
                             "slots are held unreleased by the consumer — "
                             "release() earlier chunks/windows before "
                             "requesting more, or raise prefetch_depth"
+                        )
+                    if (
+                        self.stall_timeout_s is not None
+                        and self._thread.is_alive()
+                        and time.monotonic() - self._beat
+                        > self.stall_timeout_s
+                    ):
+                        raise RuntimeError(
+                            f"{self._thread.name}: producer stalled — no "
+                            f"progress for > {self.stall_timeout_s}s "
+                            "(stall watchdog; a wedged read would "
+                            "otherwise hang the stream)"
                         )
                     continue
                 if item is None:
@@ -188,11 +218,21 @@ class BufferRotation:
         finally:
             self.close()
 
-    def close(self) -> None:
-        """Stop the producer and join it (idempotent; safe mid-stream)."""
+    def close(self, join_timeout_s: float = 10.0) -> None:
+        """Stop the producer and join it (idempotent; safe mid-stream).
+        The join is bounded: a producer wedged inside a fill (the stall
+        watchdog's trigger) must not convert consumer teardown into the
+        very hang it detected — the daemon thread is abandoned with a
+        warning and exits at its next ``acquire``."""
         self._stop.set()
         if self._started:
-            self._thread.join()
+            self._thread.join(timeout=join_timeout_s)
+            if self._thread.is_alive():
+                log.warning(
+                    "%s: producer did not exit within %.1fs of close; "
+                    "abandoning the daemon thread", self._thread.name,
+                    join_timeout_s,
+                )
 
 
 @dataclass

@@ -18,6 +18,7 @@ from typing import Callable, Dict, Optional, Tuple
 
 import numpy as np
 
+from blit import faults
 from blit.config import nfpc_from_foff
 from blit.inventory import get_inventory  # noqa: F401  (re-export: workers run it)
 from blit.io import fbh5, sigproc
@@ -73,10 +74,18 @@ def get_fb_data(
     unnecessary here — the memmap unmaps on GC)."""
     if len(idxs) != 3:
         raise ValueError("idxs must have exactly three indices")
-    _, mm = sigproc.read_fil_data(path, mmap=True)
-    data = np.ascontiguousarray(mm[idxs])
-    del mm
-    return fqav(data, fqav_by, f=fqav_func)
+
+    def _read():
+        # Transient NFS weather retries under faults.io_policy(); the
+        # materializing copy happens inside so page-in faults retry too.
+        faults.fire("workers.read", key=path)
+        _, mm = sigproc.read_fil_data(path, mmap=True)
+        data = np.ascontiguousarray(mm[idxs])
+        del mm
+        return data
+
+    return fqav(faults.retry_io(_read, describe=f"read {path}"),
+                fqav_by, f=fqav_func)
 
 
 def get_fbh5_data(
@@ -87,8 +96,13 @@ def get_fbh5_data(
 ) -> np.ndarray:
     """Hyperslab-read an FBH5 file then frequency-average — averaging is
     post-read, on the worker (reference: src/gbtworkerfunctions.jl:179-189)."""
-    data = fbh5.read_fbh5_data(path, idxs)
-    return fqav(data, fqav_by, f=fqav_func)
+
+    def _read():
+        faults.fire("workers.read", key=path)
+        return fbh5.read_fbh5_data(path, idxs)
+
+    return fqav(faults.retry_io(_read, describe=f"read {path}"),
+                fqav_by, f=fqav_func)
 
 
 def get_data(

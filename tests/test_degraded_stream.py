@@ -1,0 +1,278 @@
+"""Degraded-antenna continuation in the streaming collectives (ISSUE 2
+tentpole): injected transient read faults retry to byte-identical
+results; a HARD mid-stream antenna failure masks that antenna
+(zero-weight, flagged in the result metadata) instead of aborting the
+scan; producer stalls are bounded by a watchdog; producer exceptions
+propagate promptly."""
+
+import time
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from blit import faults  # noqa: E402
+from blit.faults import FaultRule, InjectedFault, RetryPolicy  # noqa: E402
+from blit.ops.channelize import pfb_coeffs  # noqa: E402
+from blit.parallel.antenna import (  # noqa: E402
+    AntennaStream,
+    CorrelatorStream,
+    load_antennas_mesh,
+)
+from blit.parallel.beamform import (  # noqa: E402
+    antenna_sharding,
+    beamform,
+    beamform_stream,
+    weight_sharding,
+)
+from blit.parallel.correlator import correlate_stream  # noqa: E402
+from blit.parallel.mesh import make_mesh  # noqa: E402
+from blit.testing import synth_raw  # noqa: E402
+
+NANT, NCHAN, NPOL = 4, 4, 2
+KEPT = 960          # gap-free samples per recording
+START = 48          # every test re-enters mid-recording
+TOTAL = 896         # samples consumed from START
+W = 128             # beamform window (7 windows)
+NINT = 4
+NFFT, NTAP, WF = 16, 4, 8
+
+
+@pytest.fixture(autouse=True)
+def clean_faults():
+    faults.clear()
+    faults.reset_counters()
+    # Deterministic, sleepless backoff for every injected-transient test.
+    faults.set_io_policy(RetryPolicy(attempts=3, base_s=0.0, jitter=0.0))
+    yield
+    faults.clear()
+    faults.reset_counters()
+    faults.set_io_policy(None)
+
+
+@pytest.fixture(scope="module")
+def ant_files(tmp_path_factory):
+    d = tmp_path_factory.mktemp("degraded_ants")
+    paths = []
+    for a in range(NANT):
+        p = str(d / f"ant{a}.raw")
+        synth_raw(p, nblocks=2, obsnchan=NCHAN, ntime_per_block=KEPT // 2,
+                  seed=200 + a, tone_chan=a % NCHAN)
+        paths.append(p)
+    return paths
+
+
+@pytest.fixture(scope="module")
+def weights():
+    rng = np.random.default_rng(5)
+    return (rng.standard_normal((3, NANT, NCHAN))
+            + 1j * rng.standard_normal((3, NANT, NCHAN))).astype(np.complex64)
+
+
+def put_weights(w, mesh):
+    ws = weight_sharding(mesh)
+    return (jax.device_put(w.real.astype(np.float32), ws),
+            jax.device_put(w.imag.astype(np.float32), ws))
+
+
+def run_stream(feed, wput, mesh, windows=None):
+    """Drive beamform_stream, recording each window's masked tuple."""
+    def spy(f):
+        for win in f:
+            if windows is not None:
+                windows.append(win.masked)
+            yield win
+
+    return np.concatenate(
+        list(beamform_stream(spy(feed), wput, mesh=mesh, nint=NINT)), axis=2
+    )
+
+
+class TestTransientRetryTransparency:
+    def test_stream_with_injected_read_faults_is_byte_identical(
+            self, ant_files, weights):
+        # THE acceptance scenario: transient read faults (flaky NFS) on
+        # one antenna retry inside the producer and the streamed beam
+        # powers come out byte-identical to the fault-free run.
+        mesh = make_mesh(1, 4)
+        wput = put_weights(weights, mesh)
+        _, vp = load_antennas_mesh(ant_files, mesh=mesh,
+                                   start_sample=START, max_samples=TOTAL)
+        one = np.asarray(beamform(vp, wput, mesh=mesh, nint=NINT))
+        faults.install(FaultRule("guppi.read", "fail", times=2, match="ant1"))
+        feed = AntennaStream(ant_files, mesh=mesh, window_samples=W,
+                             start_sample=START, max_samples=TOTAL)
+        got = run_stream(feed, wput, mesh)
+        np.testing.assert_array_equal(got, one)
+        assert feed.masked_antennas == set()  # recovered, nothing degraded
+        assert faults.counters()["retry.io"] >= 2
+        assert faults.counters()["fault.guppi.read.fail"] == 2
+
+
+class TestDegradedBeamform:
+    def test_hard_midstream_failure_masks_antenna_not_abort(
+            self, ant_files, weights):
+        # A truncate fault is HARD (short read — never retried): the
+        # stream must complete with antenna 2 zero-weighted from the
+        # failing window on, flagged in the metadata, and the output
+        # byte-identical to a one-shot beamform over planes with that
+        # antenna zeroed from the same window boundary.
+        mesh = make_mesh(1, 4)
+        wput = put_weights(weights, mesh)
+        faults.install(
+            FaultRule("guppi.read", "truncate", times=1, after=2,
+                      match="ant2")
+        )
+        feed = AntennaStream(ant_files, mesh=mesh, window_samples=W,
+                             start_sample=START, max_samples=TOTAL,
+                             on_antenna_error="mask")
+        per_window = []
+        got = run_stream(feed, wput, mesh, windows=per_window)
+
+        assert feed.masked_antennas == {2}
+        assert feed.header["_masked_antennas"] == [2]
+        wmask = next(i for i, m in enumerate(per_window) if m)
+        assert 0 < wmask < feed.nwindows - 1  # genuinely mid-stream
+        assert all(m == (2,) for m in per_window[wmask:])
+
+        _, (vr, vi) = load_antennas_mesh(ant_files, mesh=mesh,
+                                         start_sample=START,
+                                         max_samples=TOTAL)
+        zr = np.asarray(vr).copy()
+        zi = np.asarray(vi).copy()
+        zr[2, :, wmask * W:] = 0
+        zi[2, :, wmask * W:] = 0
+        sh = antenna_sharding(mesh)
+        golden = np.asarray(beamform(
+            (jax.device_put(zr, sh), jax.device_put(zi, sh)), wput,
+            mesh=mesh, nint=NINT,
+        ))
+        np.testing.assert_array_equal(got, golden)
+
+        # A degraded run SAYS so: feed timeline + global fault counters.
+        rep = feed.timeline.report(include_faults=True)
+        assert rep["antenna.masked"]["calls"] == 1
+        assert rep["faults"]["mask.antenna"] == 1
+
+    def test_retry_exhaustion_masks_under_mask_mode(self, ant_files,
+                                                    weights):
+        # Persistent transient failure (dead mount): retries exhaust,
+        # then the mask policy converts the hard failure into degraded
+        # continuation from window 0.
+        mesh = make_mesh(1, 4)
+        wput = put_weights(weights, mesh)
+        faults.install(FaultRule("guppi.read", "fail", times=-1,
+                                 match="ant3"))
+        feed = AntennaStream(ant_files, mesh=mesh, window_samples=W,
+                             start_sample=START, max_samples=TOTAL,
+                             on_antenna_error="mask")
+        got = run_stream(feed, wput, mesh)
+        assert feed.masked_antennas == {3}
+        faults.clear()  # disarm before reading the golden's planes
+        _, (vr, vi) = load_antennas_mesh(ant_files, mesh=mesh,
+                                         start_sample=START,
+                                         max_samples=TOTAL)
+        zr = np.asarray(vr).copy()
+        zi = np.asarray(vi).copy()
+        zr[3] = 0
+        zi[3] = 0
+        sh = antenna_sharding(mesh)
+        golden = np.asarray(beamform(
+            (jax.device_put(zr, sh), jax.device_put(zi, sh)), wput,
+            mesh=mesh, nint=NINT,
+        ))
+        np.testing.assert_array_equal(got, golden)
+
+    def test_default_policy_still_raises(self, ant_files, weights):
+        # on_antenna_error="raise" (the default) preserves the loud
+        # behavior: hard failures abort promptly (no rotation deadlock).
+        mesh = make_mesh(1, 4)
+        wput = put_weights(weights, mesh)
+        faults.set_io_policy(RetryPolicy(attempts=2, base_s=0.0))
+        faults.install(FaultRule("guppi.read", "fail", times=-1,
+                                 match="ant0"))
+        feed = AntennaStream(ant_files, mesh=mesh, window_samples=W,
+                             start_sample=START, max_samples=TOTAL)
+        t0 = time.monotonic()
+        with pytest.raises(InjectedFault):
+            for win in feed:
+                win.release()
+        assert time.monotonic() - t0 < 30
+
+    def test_bad_policy_name_rejected(self, ant_files):
+        mesh = make_mesh(1, 4)
+        with pytest.raises(ValueError, match="on_antenna_error"):
+            AntennaStream(ant_files, mesh=mesh, window_samples=W,
+                          on_antenna_error="ignore")
+
+
+class TestDegradedCorrelator:
+    def test_hard_midstream_failure_masks_antenna(self, ant_files):
+        import jax.numpy as jnp
+
+        mesh = make_mesh(2, 2)
+        coeffs = jnp.asarray(pfb_coeffs(NTAP, NFFT).astype(np.float32))
+
+        def stream(**kw):
+            feed = CorrelatorStream(ant_files, mesh=mesh, nfft=NFFT,
+                                    ntap=NTAP, window_frames=WF,
+                                    start_sample=START, **kw)
+            from blit.observability import Timeline
+
+            tl = Timeline()
+            visr, visi = correlate_stream(feed, coeffs, mesh=mesh,
+                                          nfft=NFFT, ntap=NTAP, timeline=tl)
+            return feed, tl, np.asarray(visr), np.asarray(visi)
+
+        _, _, cr, ci = stream()
+        faults.install(
+            FaultRule("guppi.read", "truncate", times=1, after=3,
+                      match="ant2")
+        )
+        feed, tl, gr, gi = stream(on_antenna_error="mask")
+
+        # Completed degraded, flagged in the metadata + driver tables.
+        assert feed.masked_antennas == {2}
+        assert feed.header["_masked_antennas"] == [2]
+        assert tl.stages["masked_antennas"].calls >= 1
+        assert np.isfinite(gr).all() and np.isfinite(gi).all()
+        # Baselines not involving the masked antenna are untouched —
+        # byte-identical to the fault-free stream (pairwise cross
+        # products never read antenna 2's spectra).
+        keep = np.array([0, 1, 3])
+        np.testing.assert_array_equal(gr[np.ix_(keep, keep)],
+                                      cr[np.ix_(keep, keep)])
+        np.testing.assert_array_equal(gi[np.ix_(keep, keep)],
+                                      ci[np.ix_(keep, keep)])
+        # The masked antenna's visibilities lost the post-mask windows.
+        assert not np.array_equal(gr[2, 2], cr[2, 2])
+
+
+class TestStallWatchdog:
+    def test_wedged_producer_bounds_the_hang(self, ant_files):
+        # A wedged read (injected delay far beyond the watchdog) must
+        # surface as a prompt RuntimeError, not an unbounded hang.
+        mesh = make_mesh(1, 4)
+        faults.install(
+            FaultRule("antenna.produce", "delay", times=1, delay_s=1.0)
+        )
+        feed = AntennaStream(ant_files, mesh=mesh, window_samples=W,
+                             start_sample=START, max_samples=TOTAL,
+                             stall_timeout_s=0.2)
+        t0 = time.monotonic()
+        with pytest.raises(RuntimeError, match="stalled"):
+            for win in feed:
+                win.release()
+        assert time.monotonic() - t0 < 10
+
+    def test_healthy_stream_unaffected_by_watchdog(self, ant_files):
+        mesh = make_mesh(1, 4)
+        feed = AntennaStream(ant_files, mesh=mesh, window_samples=W,
+                             start_sample=START, max_samples=TOTAL,
+                             stall_timeout_s=5.0)
+        n = 0
+        for win in feed:
+            win.release()
+            n += 1
+        assert n == feed.nwindows

@@ -1,0 +1,490 @@
+"""Deterministic fault injection + recovery policy (ISSUE 2 tentpole):
+the injection registry, seeded-jitter retry (no test sleeps real backoff
+time — sleeps and clocks are injectable), transparent transient-I/O
+recovery in the guppi/fbh5 layers, the WorkerPool re-dispatch path, and
+the per-host circuit breaker."""
+
+import io
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from blit import faults, workers
+from blit.agent import ping
+from blit.config import SiteConfig
+from blit.faults import CircuitBreaker, FaultRule, InjectedFault, RetryPolicy
+from blit.io.guppi import GuppiRaw
+from blit.parallel import pool as poolmod
+from blit.parallel.pool import WorkerError, WorkerPool
+from blit.parallel.remote import (
+    RemoteError,
+    agent_env_with_repo,
+    local_agent_command,
+)
+from blit.testing import synth_fil, synth_raw
+
+
+@pytest.fixture(autouse=True)
+def clean_faults():
+    faults.clear()
+    faults.reset_counters()
+    faults.set_io_policy(RetryPolicy(attempts=3, base_s=0.0, jitter=0.0))
+    yield
+    faults.clear()
+    faults.reset_counters()
+    faults.set_io_policy(None)
+
+
+def local_transport(host):
+    return local_agent_command()
+
+
+class TestRegistry:
+    def test_fail_rule_fires_exactly_times(self):
+        faults.install(FaultRule("p", "fail", times=2))
+        for _ in range(2):
+            with pytest.raises(InjectedFault):
+                faults.fire("p")
+        assert faults.fire("p") is None  # exhausted
+        assert faults.counters()["fault.p.fail"] == 2
+
+    def test_after_offsets_the_firing_window(self):
+        faults.install(FaultRule("p", "fail", times=1, after=2))
+        assert faults.fire("p") is None
+        assert faults.fire("p") is None
+        with pytest.raises(InjectedFault):
+            faults.fire("p")  # 3rd matching hit
+        assert faults.fire("p") is None
+
+    def test_match_filters_by_key_substring(self):
+        faults.install(FaultRule("p", "fail", times=-1, match="ant2"))
+        assert faults.fire("p", key="/data/ant1.raw") is None
+        with pytest.raises(InjectedFault):
+            faults.fire("p", key="/data/ant2.raw")
+        assert faults.fire("p") is None  # no key, match rule skips
+
+    def test_delay_uses_injectable_sleep(self):
+        rec = []
+        faults.install(
+            FaultRule("p", "delay", times=1, delay_s=7.5, sleep=rec.append)
+        )
+        assert faults.fire("p") is None
+        assert rec == [7.5]
+
+    def test_destructive_rule_returned_to_caller(self):
+        faults.install(FaultRule("p", "truncate", times=1, amount=3))
+        act = faults.fire("p")
+        assert act.mode == "truncate" and act.amount == 3
+        assert faults.fire("p") is None
+
+    def test_parse_spec_grammar(self):
+        rules = faults.parse_spec(
+            "guppi.read:fail:2:match=ant1;"
+            "remote.call:delay:times=-1:delay=0.25;"
+            "fbh5.write:truncate:1:after=4:amount=8"
+        )
+        assert [r.point for r in rules] == [
+            "guppi.read", "remote.call", "fbh5.write"
+        ]
+        assert rules[0].times == 2 and rules[0].match == "ant1"
+        assert rules[1].times == -1 and rules[1].delay_s == 0.25
+        assert rules[2].after == 4 and rules[2].amount == 8
+        with pytest.raises(ValueError, match="point:mode"):
+            faults.parse_spec("lonely")
+        with pytest.raises(ValueError, match="unknown fault mode"):
+            faults.parse_spec("p:explode")
+
+    def test_hit_counting_is_thread_safe(self):
+        faults.install(FaultRule("p", "fail", times=50))
+        raised = []
+
+        def worker():
+            for _ in range(20):
+                try:
+                    faults.fire("p")
+                except InjectedFault:
+                    raised.append(1)
+
+        threads = [threading.Thread(target=worker) for _ in range(8)]
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+        assert len(raised) == 50  # exactly `times`, no lost updates
+
+
+class TestRetryPolicy:
+    def test_seeded_jitter_is_deterministic_and_bounded(self):
+        a = RetryPolicy(base_s=0.1, max_s=2.0, jitter=0.5, seed=7)
+        b = RetryPolicy(base_s=0.1, max_s=2.0, jitter=0.5, seed=7)
+        for k in range(6):
+            d = a.delay_s(k)
+            assert d == b.delay_s(k)  # pure function of (seed, attempt)
+            nominal = min(2.0, 0.1 * 2.0 ** k)
+            assert 0.5 * nominal <= d <= 1.5 * nominal
+
+    def test_transient_failures_retry_then_succeed(self):
+        rec = []
+        policy = RetryPolicy(attempts=3, base_s=0.5, jitter=0.0,
+                             sleep=rec.append)
+        calls = [0]
+
+        def flaky():
+            calls[0] += 1
+            if calls[0] < 3:
+                raise OSError("nfs weather")
+            return 42
+
+        assert faults.retry_call(flaky, policy=policy) == 42
+        assert rec == [0.5, 1.0]  # exponential, recorded not slept
+        assert faults.counters()["retry.io"] == 2
+
+    def test_non_transient_never_retries(self):
+        policy = RetryPolicy(attempts=5, base_s=0.0)
+        for exc in (FileNotFoundError("gone"), PermissionError("no"),
+                    ValueError("logic")):
+            calls = [0]
+
+            def bad(exc=exc):
+                calls[0] += 1
+                raise exc
+
+            with pytest.raises(type(exc)):
+                faults.retry_call(bad, policy=policy)
+            assert calls[0] == 1
+
+    def test_attempts_bound_exhaustion(self):
+        rec = []
+        policy = RetryPolicy(attempts=4, base_s=0.1, jitter=0.0,
+                             sleep=rec.append)
+
+        def always():
+            raise OSError("down")
+
+        with pytest.raises(OSError, match="down"):
+            faults.retry_call(always, policy=policy)
+        assert len(rec) == 3  # attempts - 1 backoffs
+
+
+class TestGuppiIORecovery:
+    @pytest.fixture
+    def raw(self, tmp_path):
+        p = str(tmp_path / "ant0.raw")
+        synth_raw(p, nblocks=2, obsnchan=4, ntime_per_block=64, seed=1)
+        return p
+
+    def test_transient_read_fault_is_invisible(self, raw):
+        from blit.parallel.scan import _gapless
+
+        clean = np.array(_gapless(GuppiRaw(raw), 96, skip=8))
+        faults.install(FaultRule("guppi.read", "fail", times=2))
+        got = _gapless(GuppiRaw(raw), 96, skip=8)
+        np.testing.assert_array_equal(got, clean)
+        assert faults.counters()["retry.io"] >= 2
+
+    def test_transient_open_fault_is_invisible(self, raw):
+        faults.install(FaultRule("guppi.open", "fail", times=1))
+        assert GuppiRaw(raw).nblocks == 2
+        assert faults.counters()["retry.io"] >= 1
+
+    def test_retry_exhaustion_raises(self, raw):
+        faults.set_io_policy(RetryPolicy(attempts=2, base_s=0.0))
+        faults.install(FaultRule("guppi.read", "fail", times=-1))
+        r = GuppiRaw(raw)
+        dst = np.empty((4, 16, 2, 2), np.int8)
+        with pytest.raises(InjectedFault):
+            r.read_block_into(0, dst, 0, 16)
+
+    def test_truncate_injection_shortens_the_read(self, raw):
+        r = GuppiRaw(raw)
+        dst = np.empty((4, 32, 2, 2), np.int8)
+        faults.install(FaultRule("guppi.read", "truncate", times=1, amount=10))
+        assert r.read_block_into(0, dst, 0, 32) == 22
+        assert r.read_block_into(0, dst, 0, 32) == 32  # rule exhausted
+
+    def test_truncate_surfaces_as_short_gapless(self, raw):
+        from blit.parallel.scan import _gapless
+
+        faults.install(FaultRule("guppi.read", "truncate", times=1))
+        v = _gapless(GuppiRaw(raw), 96, skip=0)
+        assert v.shape[1] < 96  # callers' length checks turn this hard
+
+    def test_read_block_honors_destructive_rules(self, raw):
+        # The whole-block path must apply truncate/corrupt too — a drill
+        # must never count a fault as fired while delivering clean data.
+        r = GuppiRaw(raw)
+        clean = np.array(r.read_block(0))
+        faults.install(FaultRule("guppi.read", "truncate", times=1,
+                                 amount=10))
+        assert r.read_block(0).shape[1] == clean.shape[1] - 10
+        faults.clear()
+        faults.install(FaultRule("guppi.read", "corrupt", times=1))
+        bad = r.read_block(0)
+        assert not np.array_equal(bad, clean)
+        np.testing.assert_array_equal(bad[1:], clean[1:])
+
+    def test_corrupt_injection_flips_frame_bytes(self, raw):
+        r = GuppiRaw(raw)
+        clean = np.array(r.read_block(0))
+        faults.install(FaultRule("guppi.read", "corrupt", times=1))
+        dst = np.zeros((4, 64, 2, 2), np.int8)
+        r.read_block_into(0, dst, 0, 64)
+        assert not np.array_equal(dst, clean)
+        np.testing.assert_array_equal(dst[1:], clean[1:])  # channel 0 only
+
+    def test_workers_read_retries_transient(self, tmp_path):
+        p = str(tmp_path / "x.fil")
+        _, data = synth_fil(p, nsamps=8, nchans=32)
+        faults.install(FaultRule("workers.read", "fail", times=1))
+        out = workers.get_data(p, (slice(None), slice(None), slice(None)))
+        np.testing.assert_array_equal(out, data)
+        assert faults.counters()["retry.io"] >= 1
+
+
+class TestFBH5WriteRecovery:
+    def test_transient_write_fault_is_invisible(self, tmp_path):
+        from blit.io.fbh5 import FBH5Writer, read_fbh5_data
+        from blit.testing import make_fil_header
+
+        hdr = make_fil_header(nchans=8, nifs=1)
+        slabs = [np.random.default_rng(s).standard_normal(
+            (4, 1, 8)).astype(np.float32) for s in range(3)]
+
+        def write(path):
+            with FBH5Writer(path, hdr, nifs=1, nchans=8) as w:
+                for s in slabs:
+                    w.append(s)
+
+        clean = str(tmp_path / "clean.h5")
+        write(clean)
+        faults.install(FaultRule("fbh5.write", "fail", times=2))
+        faulty = str(tmp_path / "faulty.h5")
+        write(faulty)
+        np.testing.assert_array_equal(
+            read_fbh5_data(faulty), read_fbh5_data(clean)
+        )
+        assert faults.counters()["retry.io"] >= 2
+
+
+class TestCircuitBreaker:
+    def test_trips_after_consecutive_failures_and_recloses(self):
+        now = [0.0]
+        br = CircuitBreaker(threshold=3, cooldown_s=60.0,
+                            clock=lambda: now[0])
+        assert br.allow() and not br.record_failure()
+        assert br.allow() and not br.record_failure()
+        assert br.allow()
+        assert br.record_failure()  # third consecutive: trips
+        assert br.snapshot() == {
+            "state": "open", "consecutive_failures": 3, "trips": 1,
+        }
+        assert not br.allow()  # fail fast inside cooldown
+        now[0] = 61.0
+        assert br.allow()       # the half-open probe
+        assert not br.allow()   # only ONE probe
+        br.record_success()
+        assert br.snapshot()["state"] == "closed"
+
+    def test_half_open_failure_reopens(self):
+        now = [0.0]
+        br = CircuitBreaker(threshold=1, cooldown_s=10.0,
+                            clock=lambda: now[0])
+        br.record_failure()
+        now[0] = 11.0
+        assert br.allow()
+        assert br.record_failure()  # probe failed: open again
+        assert not br.allow()
+        assert br.trips == 2
+
+    def test_success_resets_consecutive_count(self):
+        br = CircuitBreaker(threshold=3)
+        br.record_failure()
+        br.record_failure()
+        br.record_success()
+        assert not br.record_failure()  # streak restarted
+        assert br.snapshot()["state"] == "closed"
+
+
+def _pool_config(**kw):
+    """Fast deterministic recovery knobs: zero backoff, seeded jitter."""
+    defaults = dict(call_retries=1, call_backoff_s=0.0,
+                    call_backoff_max_s=0.0, retry_jitter=0.0, retry_seed=0,
+                    breaker_threshold=2, breaker_cooldown_s=60.0)
+    defaults.update(kw)
+    return SiteConfig(**defaults)
+
+
+class TestPoolRecovery:
+    def test_injected_agent_death_is_retried_through_respawn(self):
+        faults.install(FaultRule("remote.call", "fail", times=1))
+        pool = WorkerPool(
+            ["h0"], backend="remote", transport=local_transport,
+            agent_env=agent_env_with_repo(), config=_pool_config(),
+        )
+        try:
+            assert pool.run_on([1], ping, [()]) == ["pong"]
+        finally:
+            pool.shutdown()
+        assert faults.counters()["retry.remote"] == 1
+        assert pool.health()[0]["state"] == "closed"
+
+    def test_persistent_failure_trips_breaker_then_fails_fast(self):
+        rule = FaultRule("remote.call", "fail", times=-1, match="h0")
+        faults.install(rule)
+        pool = WorkerPool(
+            ["h0", "h1"], backend="remote", transport=local_transport,
+            agent_env=agent_env_with_repo(), config=_pool_config(),
+        )
+        try:
+            res = pool.broadcast(ping, on_error="capture")
+            assert isinstance(res[0], WorkerError)
+            assert res[0].error.etype == "AgentDied"
+            assert res[1] == "pong"  # the healthy host is untouched
+            # call_retries=1 + threshold=2: the breaker tripped during the
+            # first fan-out.
+            health = {h["host"]: h for h in pool.health()}
+            assert health["h0"]["state"] == "open"
+            assert health["h1"]["state"] == "closed"
+            assert faults.counters()["breaker.trip"] == 1
+            fired_before = rule.fired
+            res = pool.broadcast(ping, on_error="capture")
+            # Degraded host fails FAST: reported, not hammered — the
+            # transport was never touched again.
+            assert isinstance(res[0], WorkerError)
+            assert res[0].error.etype == "HostDegraded"
+            assert rule.fired == fired_before
+            assert faults.counters()["breaker.fastfail"] == 1
+        finally:
+            pool.shutdown()
+
+    def test_breaker_probe_recloses_after_cooldown(self):
+        rule = FaultRule("remote.call", "fail", times=2)
+        faults.install(rule)
+        pool = WorkerPool(
+            ["h0"], backend="remote", transport=local_transport,
+            agent_env=agent_env_with_repo(),
+            config=_pool_config(call_retries=0),
+        )
+        try:
+            for _ in range(2):  # two failures trip the breaker
+                with pytest.raises(RemoteError):
+                    pool.run_on([1], ping, [()])
+            assert pool.health()[0]["state"] == "open"
+            # Advance the (injectable) clock past the cooldown: the next
+            # call is the half-open probe, succeeds, and re-closes.
+            br = pool.workers[0].breaker
+            base = time.monotonic()
+            br.clock = lambda: base + br.cooldown_s + 1
+            assert pool.run_on([1], ping, [()]) == ["pong"]
+            assert pool.health()[0]["state"] == "closed"
+        finally:
+            pool.shutdown()
+
+    def test_degraded_run_report_includes_fault_counters(self):
+        from blit.observability import Timeline
+
+        faults.install(FaultRule("remote.call", "fail", times=-1))
+        pool = WorkerPool(
+            ["h0"], backend="remote", transport=local_transport,
+            agent_env=agent_env_with_repo(), config=_pool_config(),
+        )
+        try:
+            pool.broadcast(ping, on_error="capture")
+        finally:
+            pool.shutdown()
+        rep = Timeline().report(include_faults=True)
+        assert rep["faults"]["breaker.trip"] == 1
+        assert rep["faults"]["retry.remote"] == 1
+
+
+class TestFanInCancellation:
+    """A first-worker failure under on_error="raise" must not leak the
+    rest of the fan-out as orphaned background work (ISSUE 2 satellite).
+    Queued-future cancellation is inherently racy to observe through a
+    live executor, so the pin is structural: stub futures, remote-backend
+    pool with the local transport."""
+
+    def _pool(self):
+        return WorkerPool(
+            ["a", "b", "c"], backend="remote", transport=local_transport,
+            agent_env=agent_env_with_repo(),
+        )
+
+    def _stub_futures(self, pool, exc):
+        from concurrent.futures import Future
+
+        f1, f2, f3 = Future(), Future(), Future()
+        f1.set_exception(exc)
+        futs = iter([f1, f2, f3])
+        pool._submit = lambda *a, **kw: next(futs)
+        return f1, f2, f3
+
+    def test_run_on_raise_cancels_not_yet_started_futures(self):
+        pool = self._pool()
+        try:
+            _f1, f2, f3 = self._stub_futures(pool, RuntimeError("boom"))
+            with pytest.raises(RuntimeError, match="boom"):
+                pool.run_on([1, 2, 3], ping, [(), (), ()])
+            assert f2.cancelled() and f3.cancelled()
+        finally:
+            pool.shutdown()
+
+    def test_broadcast_raise_cancels_not_yet_started_futures(self):
+        pool = self._pool()
+        try:
+            _f1, f2, f3 = self._stub_futures(pool, RuntimeError("boom"))
+            with pytest.raises(RuntimeError, match="boom"):
+                pool.broadcast(ping)
+            assert f2.cancelled() and f3.cancelled()
+        finally:
+            pool.shutdown()
+
+    def test_capture_mode_still_waits_everyone(self):
+        pool = self._pool()
+        try:
+            from concurrent.futures import Future
+
+            f1, f2, f3 = Future(), Future(), Future()
+            f1.set_exception(RuntimeError("boom"))
+            f2.set_result("ok2")
+            f3.set_result("ok3")
+            futs = iter([f1, f2, f3])
+            pool._submit = lambda *a, **kw: next(futs)
+            res = pool.broadcast(ping, on_error="capture")
+            assert isinstance(res[0], WorkerError)
+            assert res[1:] == ["ok2", "ok3"]
+            assert not f2.cancelled() and not f3.cancelled()
+        finally:
+            pool.shutdown()
+
+
+class TestGlobalPoolThreadSafety:
+    def test_racing_setup_workers_builds_exactly_one_pool(self, monkeypatch):
+        poolmod.reset_pool()
+        built = []
+        orig = poolmod.WorkerPool
+
+        class Counting(orig):
+            def __init__(self, *a, **kw):
+                built.append(self)
+                super().__init__(*a, **kw)
+
+        monkeypatch.setattr(poolmod, "WorkerPool", Counting)
+        results = []
+        barrier = threading.Barrier(8)
+
+        def go():
+            barrier.wait()
+            results.append(poolmod.setup_workers(["a"], backend="local"))
+
+        threads = [threading.Thread(target=go) for _ in range(8)]
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+        try:
+            assert len(built) == 1  # no second pool built-and-leaked
+            assert len(results) == 8
+            assert all(r is results[0] for r in results)
+            assert poolmod.current_pool() is results[0]
+        finally:
+            poolmod.reset_pool()
+        assert poolmod.current_pool() is None

@@ -3,6 +3,7 @@ deadlines that KILL a wedged-but-alive agent, reuse-time ping health
 checks, and fan-in timeouts — the liveness bounds the reference's
 blocking ``fetch.`` lacked."""
 
+import io
 import os
 import sys
 import time
@@ -12,6 +13,8 @@ import pytest
 from blit.agent import ping
 from blit.parallel.pool import WorkerError, WorkerPool
 from blit.parallel.remote import (
+    _BANNER_SCAN_LIMIT,
+    _await_banner,
     RemoteError,
     RemoteWorker,
     agent_env_with_repo,
@@ -177,6 +180,62 @@ class TestFanInTimeout:
                 pool.run_on([1], time.sleep, [(1.0,)], timeout=0.2)
         finally:
             pool.shutdown()
+
+    def test_capture_past_deadline_fails_remaining_immediately(self):
+        # One shared deadline across the ordered waits: once it has
+        # passed, every remaining future gets an immediate-expiry poll —
+        # wall clock is ~timeout, NOT the sum of the workers' sleeps.
+        pool = WorkerPool(["a", "b", "c"], backend="thread")
+        try:
+            t0 = time.monotonic()
+            res = pool.run_on(
+                [1, 2, 3], time.sleep, [(0.4,), (5.0,), (5.0,)],
+                on_error="capture", timeout=0.15,
+            )
+            wall = time.monotonic() - t0
+        finally:
+            pool.shutdown()
+        assert all(isinstance(r, WorkerError) for r in res)
+        assert all(isinstance(r.error, TimeoutError) for r in res)
+        assert wall < 4.0  # never waited on the 5s sleepers
+
+    def test_timeout_is_builtin_timeout_error(self):
+        # Py<3.11 raises concurrent.futures.TimeoutError from the future;
+        # the fan-in must normalize to the builtin so callers catch one
+        # type (and the message names the late worker).
+        pool = WorkerPool(["a"], backend="thread")
+        try:
+            res = pool.run_on([1], time.sleep, [(1.0,)],
+                              on_error="capture", timeout=0.1)
+        finally:
+            pool.shutdown()
+        assert type(res[0].error) is TimeoutError
+        assert "worker 1" in str(res[0].error)
+
+
+class TestBannerScan:
+    def test_eof_before_handshake_is_agent_died(self):
+        # ssh exits (bad host key, refused connection) before the agent
+        # ever spoke: the scan must fail loudly as AgentDied, not hang.
+        with pytest.raises(RemoteError) as ei:
+            _await_banner(io.BytesIO(b"some ssh error\n"), "h")
+        assert ei.value.etype == "AgentDied"
+        assert "before handshake" in str(ei.value)
+
+    def test_immediate_eof_is_agent_died(self):
+        with pytest.raises(RemoteError) as ei:
+            _await_banner(io.BytesIO(b""), "h")
+        assert ei.value.etype == "AgentDied"
+
+    def test_over_limit_banner_noise_is_no_handshake(self):
+        # An rc file that babbles past the scan limit (or a shell prompt
+        # loop) must be rejected as NoHandshake, bounded at the limit.
+        noisy = io.BytesIO(b"x" * (_BANNER_SCAN_LIMIT + 64))
+        with pytest.raises(RemoteError) as ei:
+            _await_banner(noisy, "h")
+        assert ei.value.etype == "NoHandshake"
+        # The scan stopped AT the limit instead of draining the stream.
+        assert noisy.tell() <= _BANNER_SCAN_LIMIT + 1
 
 
 class TestConfigPlumbing:
