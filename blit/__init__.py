@@ -19,14 +19,38 @@ Layer map (mirrors SURVEY.md §1, rebuilt TPU-first):
 - ``blit.pipeline``  — GUPPI RAW → high-resolution filterbank reduction driver.
 - ``blit.faults``    — deterministic fault injection + recovery policy
   (transient-I/O retry, circuit breakers, degradation counters).
+- ``blit.serve``     — the product service layer: priority scheduler with
+  admission control, single-flight request coalescing, two-tier
+  content-addressed result cache.
 """
 
 from blit.version import __version__
 
-__all__ = ["__version__"]
+__all__ = [
+    "__version__",
+    "ProductService",
+    "ProductRequest",
+    "ProductCache",
+    "Scheduler",
+    "Overloaded",
+]
+
+# The serving layer's front-door names re-export from blit.serve (lazily —
+# `import blit` must stay light for the worker agents).
+_SERVE_EXPORTS = (
+    "ProductService",
+    "ProductRequest",
+    "ProductCache",
+    "Scheduler",
+    "Overloaded",
+)
 
 
 def __getattr__(name):
+    if name in _SERVE_EXPORTS:
+        import importlib
+
+        return getattr(importlib.import_module("blit.serve"), name)
     # Lazy submodule access (keeps `import blit` light; JAX-dependent modules
     # only load when touched).
     if name in (
@@ -41,6 +65,7 @@ def __getattr__(name):
         "config",
         "testing",
         "faults",
+        "serve",
     ):
         import importlib
 

@@ -14,6 +14,10 @@ Commands:
   inventory  Crawl a data tree (reference getinventory semantics) and
              print records as JSON lines or a table.
   info       Print the normalized header of a .fil / .h5 / .raw file.
+  serve-bench
+             Replay a zipfian request mix against a ProductService
+             (blit/serve) over synthetic RAW inputs and report hit-rate,
+             coalesce counts, and p50/p99 queue wait.
 """
 
 from __future__ import annotations
@@ -120,6 +124,103 @@ def _cmd_inventory(args: argparse.Namespace) -> int:
     for rec in records:
         print(json.dumps(rec._asdict()))
     return 0
+
+
+def _cmd_serve_bench(args: argparse.Namespace) -> int:
+    """Drive a ProductService with a zipfian request replay — the serving
+    layer's dispatch-overhead probe (ISSUE 3): most traffic re-asks for a
+    few hot products, so the report's hit-rate/coalesce/queue-wait numbers
+    are what a multi-tenant deployment would see."""
+    import math
+    import os
+    import random
+    import tempfile
+    import threading
+    import time as _time
+
+    from blit.observability import Timeline
+    from blit.serve import (
+        Overloaded,
+        ProductCache,
+        ProductRequest,
+        ProductService,
+        Scheduler,
+    )
+    from blit.testing import synth_raw
+
+    rng = random.Random(args.seed)
+    tl = Timeline()
+    with tempfile.TemporaryDirectory(prefix="blit-serve-bench-") as td:
+        # Distinct products = distinct synthetic recordings (tiny: the
+        # bench measures the serving layer, not the channelizer).
+        ntime = (8 + 3) * args.nfft  # 8 PFB frames at ntap=4
+        reqs = []
+        for i in range(args.distinct):
+            path = os.path.join(td, f"bench{i:03d}.raw")
+            synth_raw(path, nblocks=1, obsnchan=2, ntime_per_block=ntime,
+                      seed=i)
+            reqs.append(ProductRequest(raw=path, nfft=args.nfft, nint=1))
+        cache_dir = os.path.join(td, "cache") if args.disk_cache else None
+        service = ProductService(
+            cache=ProductCache(cache_dir, ram_bytes=args.ram_bytes,
+                               timeline=tl),
+            scheduler=Scheduler(max_concurrency=args.concurrency,
+                                queue_depth=args.queue_depth, timeline=tl),
+            timeline=tl,
+        )
+        # Zipfian popularity over the distinct products: p(k) ∝ 1/(k+1)^s.
+        weights = [1.0 / math.pow(k + 1, args.zipf_s)
+                   for k in range(args.distinct)]
+        picks = rng.choices(range(args.distinct), weights=weights,
+                            k=args.requests)
+        errors: list = []
+        rejected = [0]
+        lock = threading.Lock()
+        it = iter(picks)
+
+        def client_loop(cid: int) -> None:
+            while True:
+                with lock:
+                    k = next(it, None)
+                if k is None:
+                    return
+                try:
+                    service.get(reqs[k], timeout=120,
+                                client=f"client{cid}")
+                except Overloaded:
+                    with lock:
+                        rejected[0] += 1
+                except Exception as e:  # noqa: BLE001 — reported below
+                    with lock:
+                        errors.append(repr(e))
+
+        t0 = _time.perf_counter()
+        threads = [threading.Thread(target=client_loop, args=(c,))
+                   for c in range(args.clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = _time.perf_counter() - t0
+        service.close()
+        stats = service.stats()
+        qw = stats["queue_wait"]
+        print(json.dumps({
+            "requests": args.requests,
+            "distinct": args.distinct,
+            "clients": args.clients,
+            "zipf_s": args.zipf_s,
+            "wall_s": round(wall, 3),
+            "hit_rate": stats["hit_rate"],
+            "coalesced": stats["coalesced"],
+            "scheduled": stats["scheduled"],
+            "rejected_overloaded": rejected[0],
+            "queue_wait_p50_s": round(qw["p50"], 6),
+            "queue_wait_p99_s": round(qw["p99"], 6),
+            "cache": stats["cache"],
+            "errors": errors[:5],
+        }))
+        return 1 if errors else 0
 
 
 def _cmd_info(args: argparse.Namespace) -> int:
@@ -231,6 +332,30 @@ def main(argv: Optional[List[str]] = None) -> int:
     pf = sub.add_parser("info", help="print a file's normalized header")
     pf.add_argument("file")
     pf.set_defaults(fn=_cmd_info)
+
+    pb = sub.add_parser(
+        "serve-bench",
+        help="replay a zipfian request mix against a ProductService",
+    )
+    pb.add_argument("--requests", type=int, default=64,
+                    help="total requests to replay")
+    pb.add_argument("--distinct", type=int, default=8,
+                    help="distinct products in the mix")
+    pb.add_argument("--clients", type=int, default=4,
+                    help="concurrent client threads")
+    pb.add_argument("--zipf-s", type=float, default=1.1,
+                    help="zipf exponent of the popularity skew")
+    pb.add_argument("--concurrency", type=int, default=2,
+                    help="scheduler concurrency budget")
+    pb.add_argument("--queue-depth", type=int, default=64,
+                    help="bounded per-priority queue depth")
+    pb.add_argument("--ram-bytes", type=int, default=64 << 20,
+                    help="RAM cache tier byte budget")
+    pb.add_argument("--nfft", type=int, default=256)
+    pb.add_argument("--seed", type=int, default=0)
+    pb.add_argument("--disk-cache", action="store_true",
+                    help="enable the disk cache tier (tempdir)")
+    pb.set_defaults(fn=_cmd_serve_bench)
 
     args = p.parse_args(argv)
     return args.fn(args)

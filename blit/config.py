@@ -92,6 +92,18 @@ class SiteConfig:
     # breaker_cooldown_s one probe call may re-close the circuit.
     breaker_threshold: int = 3
     breaker_cooldown_s: float = 60.0
+    # Product service layer (blit/serve; ISSUE 3).  cache_ram_bytes bounds
+    # the in-RAM tier of the content-addressed product cache (LRU by byte
+    # budget); cache_dir, when set, enables the disk tier (completed
+    # FBH5 products indexed by reduction fingerprint).  serve_max_concurrency
+    # is the scheduler's base concurrency budget (shrunk proportionally by
+    # degraded hosts when a WorkerPool is attached) and serve_queue_depth
+    # bounds each priority's queue — excess submissions are REJECTED with
+    # Overloaded(retry_after_s) instead of growing the queue without bound.
+    cache_ram_bytes: int = 1 << 30
+    cache_dir: Optional[str] = None
+    serve_max_concurrency: int = 4
+    serve_queue_depth: int = 64
 
     def io_retry_policy(self):
         """The :class:`blit.faults.RetryPolicy` for worker-side file I/O —

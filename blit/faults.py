@@ -9,8 +9,11 @@ module holds both halves:
 - **Injection** (:class:`FaultRule`, :func:`fire`): a seeded,
   config/env-driven registry of named injection points threaded through
   the I/O layer (``guppi.read`` / ``guppi.open`` / ``fbh5.write`` /
-  ``workers.read``), the stream producer threads (``antenna.produce``)
-  and the remote transport (``remote.call``).  Modes: ``fail`` (raise
+  ``workers.read``), the stream producer threads (``antenna.produce``),
+  the remote transport (``remote.call``) and the product service layer
+  (``cache.publish`` — the disk publish of blit/serve/cache.py;
+  ``sched.dispatch`` — the scheduler's dispatch path, keyed by client,
+  blit/serve/scheduler.py).  Modes: ``fail`` (raise
   :class:`InjectedFault` — an ``OSError``, so retry paths treat it like
   a flaky NFS read), ``delay`` (injectable sleep), ``truncate`` (short
   read — a *hard* failure the degraded-antenna masking handles) and
@@ -42,7 +45,7 @@ import os
 import random
 import threading
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional
 
 log = logging.getLogger("blit.faults")

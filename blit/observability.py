@@ -40,10 +40,32 @@ class StageStats:
 
 
 @dataclass
+class GaugeStats:
+    """A sampled level (queue depth, wait seconds): last value plus the
+    observed envelope.  Unlike :class:`StageStats` a gauge is not a running
+    total — re-sampling replaces ``last`` instead of accumulating."""
+
+    last: float = 0.0
+    lo: float = 0.0
+    hi: float = 0.0
+    n: int = 0
+
+    def sample(self, value: float) -> None:
+        if self.n == 0:
+            self.lo = self.hi = value
+        else:
+            self.lo = min(self.lo, value)
+            self.hi = max(self.hi, value)
+        self.last = value
+        self.n += 1
+
+
+@dataclass
 class Timeline:
     """A registry of named stage timings (one per pipeline/driver)."""
 
     stages: Dict[str, StageStats] = field(default_factory=lambda: defaultdict(StageStats))
+    gauges: Dict[str, GaugeStats] = field(default_factory=lambda: defaultdict(GaugeStats))
 
     @contextlib.contextmanager
     def stage(
@@ -69,6 +91,13 @@ class Timeline:
         s.calls += n
         s.byte_free = True
 
+    def gauge(self, name: str, value: float) -> None:
+        """Sample a level gauge (queue depth, per-job wait seconds — the
+        serving layer's load signals, ISSUE 3).  Gauges live beside the
+        stage table: levels are point samples, not running totals, so they
+        must not pollute the byte-summable stage accounting."""
+        self.gauges[name].sample(value)
+
     def report(self, include_faults: bool = False) -> Dict[str, Dict]:
         out = {}
         # list(): producer threads (the window feeds) insert stage keys
@@ -81,6 +110,12 @@ class Timeline:
             if v.byte_free:
                 row["byte_free"] = True
             out[k] = row
+        if self.gauges:
+            out["gauges"] = {
+                k: {"last": round(g.last, 6), "lo": round(g.lo, 6),
+                    "hi": round(g.hi, 6), "n": g.n}
+                for k, g in sorted(list(self.gauges.items()))
+            }
         if include_faults:
             # Process-wide failure/recovery totals (blit/faults.py):
             # retry.io / retry.remote / mask.antenna / breaker.trip /

@@ -17,14 +17,13 @@ per-chip body is exactly the single-chip reduction from
 from __future__ import annotations
 
 import functools
-from typing import Optional, Tuple
+from typing import Optional
 
 import numpy as np
 
 import jax
 
 from blit.compat import shard_map
-import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from blit.ops.channelize import channelize

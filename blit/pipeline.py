@@ -873,17 +873,36 @@ class ReductionCursor:
         except (OSError, ValueError, TypeError):
             return None
 
+    @staticmethod
+    def normalized_members(
+        raw_path: Union[str, Sequence[str]],
+        raw_size: Union[int, Sequence[int]],
+        raw_mtime_ns: Union[int, Sequence[int]],
+    ) -> List[Tuple[str, int, int]]:
+        """The raw-input identity as an order-insensitive list of
+        ``(path, size, mtime_ns)`` member triples, sorted by path.
+
+        A multi-file scan sequence is the SAME recording whatever order a
+        glob happened to list its members in — ``open_raw`` sorts members
+        before reading, so the reduced bytes are order-independent and the
+        resume/cache identity must be too (ISSUE 3 satellite: cache keys
+        must be stable across glob orderings)."""
+
+        def norm(x):
+            return list(x) if isinstance(x, (list, tuple)) else [x]
+
+        return sorted(zip(norm(raw_path), norm(raw_size), norm(raw_mtime_ns)))
+
     def matches(self, red: "RawReducer", raw_path: Union[str, Sequence[str]]) -> bool:
         try:
             size, mtime_ns = self.stat_raw(raw_path)
         except OSError:
             return False
 
-        def norm(x):
-            return list(x) if isinstance(x, (list, tuple)) else [x]
-
         return (
-            norm(self.raw_path) == norm(raw_path)
+            self.normalized_members(self.raw_path, self.raw_size,
+                                    self.raw_mtime_ns)
+            == self.normalized_members(raw_path, size, mtime_ns)
             and self.nfft == red.nfft
             and self.ntap == red.ntap
             and self.nint == red.nint
@@ -892,6 +911,4 @@ class ReductionCursor:
             and self.fqav_by == red.fqav_by
             and self.dtype == red.dtype
             and self.despike_nfpc == getattr(red, "despike_nfpc", -1)
-            and norm(self.raw_size) == norm(size)
-            and norm(self.raw_mtime_ns) == norm(mtime_ns)
         )

@@ -1,0 +1,34 @@
+"""blit.serve — the product service layer (ISSUE 3).
+
+The multi-tenant serving stack over the reduction machinery:
+
+- :mod:`blit.serve.cache` — two-tier (RAM LRU over disk FBH5)
+  content-addressed product cache keyed by reduction fingerprint;
+- :mod:`blit.serve.scheduler` — priority scheduler with admission control
+  (bounded queues, :class:`Overloaded` rejection, fair share across
+  clients, health-aware concurrency budget);
+- :mod:`blit.serve.service` — :class:`ProductService`, the front door:
+  ``submit() -> Ticket`` / ``result()`` / ``get()``, single-flight
+  request coalescing, cache-first serving.
+"""
+
+from blit.serve.cache import (
+    ProductCache,
+    fingerprint_for,
+    reduction_fingerprint,
+)
+from blit.serve.scheduler import Cancelled, Job, Overloaded, Scheduler
+from blit.serve.service import ProductRequest, ProductService, Ticket
+
+__all__ = [
+    "Cancelled",
+    "Job",
+    "Overloaded",
+    "ProductCache",
+    "ProductRequest",
+    "ProductService",
+    "Scheduler",
+    "Ticket",
+    "fingerprint_for",
+    "reduction_fingerprint",
+]

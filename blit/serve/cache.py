@@ -1,0 +1,398 @@
+"""Two-tier content-addressed product cache (the serving layer's artifact
+store, ISSUE 3 tentpole).
+
+Keys are **reduction fingerprints**: a stable digest over the raw-input
+identity (the order-insensitive ``(path, size, mtime_ns)`` member triples of
+:meth:`blit.pipeline.ReductionCursor.normalized_members`) plus the full
+output-affecting reducer configuration.  Two callers asking for the same
+product of the same bytes — however their globs ordered the ``.NNNN.raw``
+members — get the same key; touching a member or changing any knob gets a
+different one.  Content addressing makes invalidation structural: a stale
+entry is simply never asked for again.
+
+Tiers:
+
+- **RAM** — an LRU dict of finished ``(header, product array)`` pairs,
+  bounded by a byte budget (``SiteConfig.cache_ram_bytes``).  Entries are
+  published complete-and-read-only under the cache lock, so a concurrent
+  reader sees a whole product or a miss — never a torn entry (eviction
+  drops the dict reference; an array already handed out stays valid).
+- **Disk** — completed FBH5 products (+ a JSON header sidecar) under one
+  directory, indexed by fingerprint.  Publish is atomic: both files are
+  written to temp names and ``os.replace``d into place, data before
+  sidecar, so the sidecar's existence marks a complete entry exactly like
+  the pipeline's ``.partial``-rename rule.  Loads re-probe the entry with
+  :func:`blit.io.fbh5.resume_target_ok`; an unreadable/corrupt entry (torn
+  by a crash mid-publish on a non-atomic filesystem, bit rot) is EVICTED
+  and reported as a miss instead of raising.
+
+Hit/miss/evict counters land on the :class:`~blit.observability.Timeline`
+(``cache.hit.ram`` / ``cache.hit.disk`` / ``cache.miss`` /
+``cache.evict.*``) and the ``cache.publish`` fault-injection point covers
+the disk publish path for drills (blit/faults.py).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import threading
+import time
+from collections import OrderedDict
+from typing import Dict, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from blit import faults
+from blit.observability import Timeline
+
+log = logging.getLogger("blit.serve.cache")
+
+
+def reduction_fingerprint(
+    raw_path: Union[str, Sequence[str]],
+    *,
+    nfft: int,
+    nint: int,
+    ntap: int = 4,
+    stokes: str = "I",
+    window: str = "hamming",
+    fqav_by: int = 1,
+    dtype: str = "float32",
+    fft_method: str = "auto",
+    extra: Optional[Dict] = None,
+) -> str:
+    """The content address of one reduction: sha256 over the canonical
+    JSON of ``(raw identity, reducer config)``.
+
+    The raw identity reuses :class:`blit.pipeline.ReductionCursor`'s
+    ``(path, size, mtime_ns)`` member triples — the same
+    "same config over the same bytes" contract the resume path enforces —
+    normalized to an order-insensitive, absolute-path member list so cache
+    keys are stable across glob orderings (ISSUE 3 satellite).  Raises
+    ``OSError`` when a member does not exist: an address over unknown
+    bytes would alias whatever lands at the path later.
+
+    ``extra`` admits future key components (e.g. a despike width for mesh
+    products) without breaking existing keys when absent.
+    """
+    from blit.pipeline import ReductionCursor
+
+    paths = [raw_path] if isinstance(raw_path, str) else list(raw_path)
+    paths = [os.path.abspath(p) for p in paths]
+    sizes, mtimes = ReductionCursor.stat_raw(paths)
+    ident = {
+        "raw": ReductionCursor.normalized_members(paths, sizes, mtimes),
+        "nfft": nfft, "ntap": ntap, "nint": nint, "stokes": stokes,
+        "window": window, "fqav_by": fqav_by, "dtype": dtype,
+        "fft_method": fft_method,
+    }
+    if extra:
+        ident["extra"] = dict(sorted(extra.items()))
+    blob = json.dumps(ident, sort_keys=True, default=str).encode()
+    return hashlib.sha256(blob).hexdigest()
+
+
+def fingerprint_for(reducer, raw_path: Union[str, Sequence[str]]) -> str:
+    """The fingerprint of ``reducer`` (a :class:`blit.pipeline.RawReducer`)
+    applied to ``raw_path`` — pulls every output-affecting knob off the
+    configured reducer so the two can never drift."""
+    return reduction_fingerprint(
+        raw_path,
+        nfft=reducer.nfft, nint=reducer.nint, ntap=reducer.ntap,
+        stokes=reducer.stokes, window=reducer.window,
+        fqav_by=reducer.fqav_by, dtype=reducer.dtype,
+        fft_method=reducer.fft_method,
+    )
+
+
+def _frozen(data: np.ndarray) -> np.ndarray:
+    """A read-only float32 view of ``data`` the cache can hand to many
+    concurrent callers: copied when the caller still holds a writable
+    reference (a later mutation must not tear a served entry)."""
+    data = np.asarray(data, np.float32)
+    if data.flags.writeable:
+        # A real copy, not ascontiguousarray (which returns the SAME
+        # array when already contiguous — freezing it would flip the
+        # caller's own buffer read-only).
+        data = data.copy()
+        data.setflags(write=False)
+    return data
+
+
+class ProductCache:
+    """Two-tier (RAM over disk) content-addressed product cache.
+
+    ``ram_bytes`` bounds the RAM tier (0 disables it); ``root=None``
+    disables the disk tier (RAM-only cache).  ``disk_bytes`` optionally
+    bounds the disk tier — oldest completed entries are evicted when a
+    publish would exceed it.  All methods are thread-safe.
+    """
+
+    def __init__(
+        self,
+        root: Optional[str] = None,
+        *,
+        ram_bytes: int = 1 << 30,
+        disk_bytes: Optional[int] = None,
+        timeline: Optional[Timeline] = None,
+    ):
+        self.root = root
+        self.ram_bytes = max(0, int(ram_bytes))
+        self.disk_bytes = disk_bytes
+        self.timeline = timeline if timeline is not None else Timeline()
+        self._lock = threading.Lock()
+        # fp -> (header, read-only data, nbytes); insertion order = LRU.
+        self._ram: "OrderedDict[str, Tuple[Dict, np.ndarray, int]]" = (
+            OrderedDict()
+        )
+        self._ram_used = 0
+        self.counts: Dict[str, int] = {
+            "hit.ram": 0, "hit.disk": 0, "miss": 0,
+            "evict.ram": 0, "evict.disk": 0, "evict.corrupt": 0,
+            "publish": 0, "publish.error": 0,
+        }
+        if root is not None:
+            os.makedirs(root, exist_ok=True)
+
+    # -- paths -------------------------------------------------------------
+    def data_path(self, fp: str) -> str:
+        return os.path.join(self.root, f"{fp}.h5")
+
+    def meta_path(self, fp: str) -> str:
+        return os.path.join(self.root, f"{fp}.json")
+
+    # -- counters ----------------------------------------------------------
+    def _count(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self.counts[name] = self.counts.get(name, 0) + n
+        self.timeline.count(f"cache.{name}", n)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            out = dict(self.counts)
+            out["ram_entries"] = len(self._ram)
+            out["ram_bytes_used"] = self._ram_used
+        return out
+
+    @property
+    def hit_rate(self) -> float:
+        s = self.stats()
+        served = s["hit.ram"] + s["hit.disk"]
+        total = served + s["miss"]
+        return served / total if total else 0.0
+
+    # -- RAM tier ----------------------------------------------------------
+    def _ram_put_locked(self, fp: str, header: Dict,
+                        data: np.ndarray) -> None:
+        nbytes = data.nbytes
+        if nbytes > self.ram_bytes:
+            return  # larger than the whole budget: disk-only entry
+        old = self._ram.pop(fp, None)
+        if old is not None:
+            self._ram_used -= old[2]
+        while self._ram_used + nbytes > self.ram_bytes and self._ram:
+            _, (_, _, b) = self._ram.popitem(last=False)
+            self._ram_used -= b
+            self.counts["evict.ram"] += 1
+            self.timeline.count("cache.evict.ram")
+        self._ram[fp] = (header, data, nbytes)
+        self._ram_used += nbytes
+
+    # -- disk tier ---------------------------------------------------------
+    def _disk_publish(self, fp: str, header: Dict, data: np.ndarray) -> None:
+        """Atomic publish: data file first, sidecar last, both via
+        write-temp-``os.replace`` — the sidecar's existence marks a
+        complete entry.  Raises on failure (the caller downgrades to a
+        RAM/serve-only result and counts it)."""
+        from blit.io import write_fbh5
+
+        faults.fire("cache.publish", key=fp)
+        self._disk_evict_for(data.nbytes)
+        suffix = f".tmp.{os.getpid()}.{threading.get_ident()}"
+        dtmp = self.data_path(fp) + suffix
+        mtmp = self.meta_path(fp) + suffix
+        try:
+            write_fbh5(dtmp, header, np.ascontiguousarray(data))
+            os.replace(dtmp, self.data_path(fp))
+            with open(mtmp, "w") as f:
+                json.dump({"fingerprint": fp, "nsamps": int(data.shape[0]),
+                           "nifs": int(data.shape[1]),
+                           "nchans": int(data.shape[2]),
+                           "nbytes": int(data.nbytes),
+                           "header": _jsonable(header)}, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(mtmp, self.meta_path(fp))
+        finally:
+            for t in (dtmp, mtmp):
+                try:
+                    os.unlink(t)
+                except OSError:
+                    pass
+
+    def _disk_evict(self, fp: str, reason: str) -> None:
+        for p in (self.meta_path(fp), self.data_path(fp)):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+        self._count(f"evict.{reason}")
+
+    def _disk_evict_for(self, incoming: int) -> None:
+        """Make room for ``incoming`` bytes under ``disk_bytes`` (oldest
+        completed entries first; no-op without a budget).  Also sweeps
+        sidecar-less ``.h5`` orphans (a crash between the data and
+        sidecar renames) old enough to not be a publish in progress —
+        they are invisible to :meth:`index` and would otherwise leak
+        outside the budget forever."""
+        if self.disk_bytes is None:
+            return
+        complete = set(self.index())
+        now_ns = time.time_ns()
+        entries = []
+        total = 0
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            names = []
+        for n in names:
+            if not n.endswith(".h5"):
+                continue
+            fp = n[:-3]
+            try:
+                st = os.stat(os.path.join(self.root, n))
+            except OSError:
+                continue
+            if fp not in complete:
+                if now_ns - st.st_mtime_ns > 60 * 10**9:
+                    self._disk_evict(fp, "disk")  # crash-orphaned data
+                continue
+            entries.append((st.st_mtime_ns, fp, st.st_size))
+            total += st.st_size
+        entries.sort()
+        while entries and total + incoming > self.disk_bytes:
+            _, fp, size = entries.pop(0)
+            self._disk_evict(fp, "disk")
+            total -= size
+
+    def _disk_load(self, fp: str) -> Optional[Tuple[Dict, np.ndarray]]:
+        """Load a completed disk entry, probing it for corruption first —
+        an entry that no longer reads as the product its sidecar claims is
+        evicted (count ``evict.corrupt``) and reported as a miss."""
+        from blit.io import read_fbh5_data
+        from blit.io.fbh5 import resume_target_ok
+
+        mpath = self.meta_path(fp)
+        if not os.path.exists(mpath):
+            return None
+        try:
+            with open(mpath) as f:
+                meta = json.load(f)
+        except (OSError, ValueError):
+            self._disk_evict(fp, "corrupt")
+            return None
+        nsamps = int(meta.get("nsamps", -1))
+        if nsamps < 0 or not resume_target_ok(
+            self.data_path(fp), int(meta["nifs"]), int(meta["nchans"]),
+            nsamps,
+        ):
+            log.warning("cache entry %s is unreadable; evicting", fp[:16])
+            self._disk_evict(fp, "corrupt")
+            return None
+        try:
+            data = read_fbh5_data(self.data_path(fp))
+        except Exception:  # noqa: BLE001 — corrupt past the probe: evict
+            self._disk_evict(fp, "corrupt")
+            return None
+        return meta["header"], _frozen(data)
+
+    # -- public surface ----------------------------------------------------
+    def get(self, fp: str) -> Optional[Tuple[Dict, np.ndarray, str]]:
+        """``(header, read-only data, tier)`` for a completed entry
+        (``tier`` in ``("ram", "disk")``; disk hits are promoted to RAM),
+        or ``None`` on a miss."""
+        with self._lock:
+            hit = self._ram.get(fp)
+            if hit is not None:
+                self._ram.move_to_end(fp)
+                self.counts["hit.ram"] += 1
+                self.timeline.count("cache.hit.ram")
+                # dict() copy out: the array is frozen, but a caller
+                # mutating a by-reference header would corrupt the entry
+                # for every later hitter.
+                return dict(hit[0]), hit[1], "ram"
+        if self.root is not None:
+            loaded = self._disk_load(fp)
+            if loaded is not None:
+                header, data = loaded
+                with self._lock:
+                    self._ram_put_locked(fp, header, data)
+                    self.counts["hit.disk"] += 1
+                self.timeline.count("cache.hit.disk")
+                return dict(header), data, "disk"
+        self._count("miss")
+        return None
+
+    def put(self, fp: str, header: Dict, data: np.ndarray) -> np.ndarray:
+        """Publish a finished product under ``fp`` (RAM, then disk spill).
+        A disk-publish failure (including an injected ``cache.publish``
+        fault) downgrades to a RAM-only entry — the result in hand is
+        still correct and MUST still be served (count
+        ``publish.error``).  Returns the read-only array the cache will
+        serve, so the publisher and later hitters share bytes."""
+        data = _frozen(data)
+        header = dict(header)
+        with self._lock:
+            self._ram_put_locked(fp, header, data)
+            self.counts["publish"] += 1
+        if self.root is not None:
+            try:
+                self._disk_publish(fp, header, data)
+            except Exception as e:  # noqa: BLE001 — serve-path must survive
+                log.warning("disk publish of %s failed: %s", fp[:16], e)
+                self._count("publish.error")
+                if not os.path.exists(self.meta_path(fp)):
+                    # A data file that landed without its sidecar (the
+                    # failure hit between the two renames) is an orphan
+                    # no index/eviction pass would ever reclaim.
+                    try:
+                        os.unlink(self.data_path(fp))
+                    except OSError:
+                        pass
+        return data
+
+    def contains(self, fp: str) -> bool:
+        with self._lock:
+            if fp in self._ram:
+                return True
+        return self.root is not None and os.path.exists(self.meta_path(fp))
+
+    def index(self) -> list:
+        """Fingerprints of the COMPLETED disk entries (sidecar present)."""
+        if self.root is None:
+            return []
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return []
+        return sorted(n[:-5] for n in names if n.endswith(".json"))
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ram.clear()
+            self._ram_used = 0
+        for fp in self.index():
+            self._disk_evict(fp, "disk")
+
+
+def _jsonable(header: Dict) -> Dict:
+    """The JSON-safe view of a product header (numpy scalars → Python)."""
+    out = {}
+    for k, v in header.items():
+        if isinstance(v, np.generic):
+            v = v.item()
+        out[k] = v
+    return out

@@ -1,0 +1,336 @@
+"""``ProductService`` — the multi-tenant front door (ISSUE 3 tentpole).
+
+Turns blit from a one-caller library into a product service: callers
+``submit()`` product requests and get tickets; identical requests share
+work at every level —
+
+- **completed** requests hit the two-tier content-addressed
+  :class:`~blit.serve.cache.ProductCache` (RAM, then disk) and return
+  without touching the GUPPI layer at all;
+- **in-flight** requests COALESCE: a single-flight group per reduction
+  fingerprint means N concurrent callers asking for the same product run
+  ONE reduction, and every caller gets the same (byte-identical,
+  read-only) result array;
+- **new** requests are admitted through the
+  :class:`~blit.serve.scheduler.Scheduler` (bounded queues, fair share,
+  health-aware concurrency budget) onto the existing reduction machinery
+  (:func:`blit.pipeline.reducer_for_product` /
+  :class:`~blit.pipeline.RawReducer`).
+
+Failures propagate the PR-2 error taxonomy per ticket
+(``RemoteError(etype="HostDegraded")``, ``TimeoutError``,
+``InjectedFault``, ...) and a failed flight is REMOVED from the
+single-flight table — later identical requests start a fresh reduction
+instead of being poisoned by a stale error.  Cancelling the last ticket
+of a still-queued flight releases its scheduler slot.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple, Union
+
+import numpy as np
+
+from blit.config import DEFAULT, SiteConfig
+from blit.observability import Timeline
+from blit.serve.cache import ProductCache, fingerprint_for
+from blit.serve.scheduler import Cancelled, Job, Overloaded, Scheduler
+
+log = logging.getLogger("blit.serve.service")
+
+
+@dataclass(frozen=True)
+class ProductRequest:
+    """One product ask: which raw recording, reduced how.
+
+    ``product`` selects a rawspec preset ("0000"/"0001"/"0002",
+    :data:`blit.pipeline.PRODUCT_PRESETS`); otherwise ``nfft``/``nint``
+    configure the reduction directly (exactly the
+    :func:`blit.workers.reduce_raw` contract).  ``raw`` may be a single
+    path or a multi-file sequence member list — member ORDER does not
+    change the request's identity (fingerprints normalize it)."""
+
+    raw: Union[str, Tuple[str, ...]]
+    product: Optional[str] = None
+    nfft: int = 1024
+    nint: int = 1
+    stokes: str = "I"
+    fqav_by: int = 1
+    dtype: str = "float32"
+
+    def __post_init__(self):
+        if isinstance(self.raw, list):
+            object.__setattr__(self, "raw", tuple(self.raw))
+        if self.product is not None and (self.nfft != 1024 or self.nint != 1):
+            raise ValueError(
+                "pass either product= or explicit nfft/nint, not both"
+            )
+
+    def reducer(self):
+        """The configured :class:`blit.pipeline.RawReducer` for this ask."""
+        from blit.pipeline import RawReducer, reducer_for_product
+
+        kw = dict(stokes=self.stokes, fqav_by=self.fqav_by, dtype=self.dtype)
+        if self.product is not None:
+            return reducer_for_product(self.product, **kw)
+        return RawReducer(nfft=self.nfft, nint=self.nint, **kw)
+
+    @property
+    def raw_source(self):
+        return list(self.raw) if isinstance(self.raw, tuple) else self.raw
+
+
+class _Flight:
+    """One single-flight group: every ticket for the same fingerprint
+    submitted while the reduction is in flight rides this object."""
+
+    __slots__ = ("fingerprint", "tickets", "job", "result", "exc", "done")
+
+    def __init__(self, fingerprint: str):
+        self.fingerprint = fingerprint
+        self.tickets: List["Ticket"] = []
+        self.job: Optional[Job] = None
+        self.result: Optional[Tuple[Dict, np.ndarray]] = None
+        self.exc: Optional[BaseException] = None
+        self.done = threading.Event()
+
+
+@dataclass
+class Ticket:
+    """A claim on one submitted request.  ``source`` records how it was
+    (or will be) satisfied: ``"ram"``/``"disk"`` cache hits complete at
+    submit time; ``"scheduled"`` started the reduction; ``"coalesced"``
+    joined one already in flight."""
+
+    fingerprint: str
+    client: str
+    source: str
+    submitted_at: float = field(default_factory=time.monotonic)
+    _flight: Optional[_Flight] = None
+    _result: Optional[Tuple[Dict, np.ndarray]] = None
+    cancelled: bool = False
+
+    @property
+    def done(self) -> bool:
+        return (self._result is not None
+                or self._flight is None
+                or self._flight.done.is_set())
+
+
+class ProductService:
+    """The serving front door (module docstring).  One instance per
+    process; all methods are thread-safe.
+
+    ``pool`` (optional) is the :class:`~blit.parallel.pool.WorkerPool`
+    whose health shrinks the scheduler's concurrency budget; the
+    reductions themselves run in the scheduler's job threads (the heavy
+    lifting releases the GIL in NumPy/HDF5/XLA)."""
+
+    def __init__(
+        self,
+        *,
+        cache: Optional[ProductCache] = None,
+        scheduler: Optional[Scheduler] = None,
+        config: SiteConfig = DEFAULT,
+        pool=None,
+        timeline: Optional[Timeline] = None,
+    ):
+        self.timeline = timeline if timeline is not None else Timeline()
+        self.cache = cache if cache is not None else ProductCache(
+            config.cache_dir, ram_bytes=config.cache_ram_bytes,
+            timeline=self.timeline,
+        )
+        self.scheduler = scheduler if scheduler is not None else Scheduler(
+            max_concurrency=config.serve_max_concurrency,
+            queue_depth=config.serve_queue_depth,
+            pool=pool, timeline=self.timeline,
+        )
+        self._lock = threading.Lock()
+        self._flights: Dict[str, _Flight] = {}
+        self.counts: Dict[str, int] = {
+            "requests": 0, "coalesced": 0, "cache_hits": 0,
+            "scheduled": 0, "rejected": 0,
+        }
+
+    # -- submission --------------------------------------------------------
+    def submit(
+        self,
+        request: ProductRequest,
+        *,
+        priority: int = 1,
+        client: str = "anon",
+        deadline_s: Optional[float] = None,
+    ) -> Ticket:
+        """Admit one request.  Returns a :class:`Ticket` (possibly already
+        complete — cache hits never enter the queue); raises
+        :class:`~blit.serve.scheduler.Overloaded` when admission control
+        refuses, and ``OSError`` when the raw input does not exist (an
+        address over unknown bytes is a caller bug, found at the door)."""
+        reducer = request.reducer()
+        fp = fingerprint_for(reducer, request.raw_source)
+        with self._lock:
+            self.counts["requests"] += 1
+        # Completed products serve straight from the cache — the hot path
+        # never touches the GUPPI layer (acceptance: the guppi.read
+        # injection point stays cold on hits).
+        hit = self.cache.get(fp)
+        if hit is not None:
+            header, data, tier = hit
+            with self._lock:
+                self.counts["cache_hits"] += 1
+            return Ticket(fp, client, tier, _result=(header, data))
+        with self._lock:
+            flight = self._flights.get(fp)
+            if flight is not None:
+                # Single-flight coalescing: ride the running reduction.
+                t = Ticket(fp, client, "coalesced", _flight=flight)
+                flight.tickets.append(t)
+                self.counts["coalesced"] += 1
+                self.timeline.count("serve.coalesced")
+                return t
+            flight = _Flight(fp)
+            t = Ticket(fp, client, "scheduled", _flight=flight)
+            flight.tickets.append(t)
+            self._flights[fp] = flight
+            try:
+                flight.job = self.scheduler.submit(
+                    lambda: self._reduce_and_publish(fp, request, flight),
+                    priority=priority, client=client, deadline_s=deadline_s,
+                )
+            except BaseException as e:
+                # ANY admission failure (Overloaded, a closed scheduler,
+                # ...) must drop the flight from the table — a leaked
+                # jobless flight would make every later identical request
+                # coalesce onto it and hang forever.
+                del self._flights[fp]
+                if isinstance(e, Overloaded):
+                    self.counts["rejected"] += 1
+                raise
+            self.counts["scheduled"] += 1
+        return t
+
+    def _reduce_and_publish(
+        self, fp: str, request: ProductRequest, flight: _Flight
+    ) -> Tuple[Dict, np.ndarray]:
+        """The scheduled job body: run the reduction, publish to the
+        cache, fulfill (or fail) every ticket on the flight."""
+        try:
+            with self.timeline.stage("serve.reduce", byte_free=True):
+                header, data = request.reducer().reduce(request.raw_source)
+            data = self.cache.put(fp, header, data)
+            self._finish(fp, flight, result=(header, data))
+            return header, data
+        except BaseException as e:  # noqa: BLE001 — per-ticket delivery
+            # Fail THIS flight's tickets but drop the group from the
+            # table: a later identical request must start fresh, not be
+            # poisoned by a stale error (transient faults recover).
+            self._finish(fp, flight, exc=e)
+            raise
+
+    def _finish(
+        self,
+        fp: str,
+        flight: _Flight,
+        result: Optional[Tuple[Dict, np.ndarray]] = None,
+        exc: Optional[BaseException] = None,
+    ) -> None:
+        with self._lock:
+            if self._flights.get(fp) is flight:
+                del self._flights[fp]
+            flight.result = result
+            flight.exc = exc
+        flight.done.set()
+
+    # -- results -----------------------------------------------------------
+    def result(
+        self, ticket: Ticket, timeout: Optional[float] = None
+    ) -> Tuple[Dict, np.ndarray]:
+        """Block until the ticket's product is ready → ``(header, data)``
+        with ``data`` read-only ``(nsamps, nif, nchans)`` float32.  Raises
+        the flight's failure for this ticket (PR-2 taxonomy passes
+        through), :class:`Cancelled` for a cancelled ticket, and the
+        builtin ``TimeoutError`` past ``timeout``."""
+        if ticket.cancelled:
+            raise Cancelled("ticket was cancelled")
+        if ticket._result is not None:
+            return ticket._result
+        flight = ticket._flight
+        if flight is None or not flight.done.wait(timeout):
+            raise TimeoutError(
+                f"product {ticket.fingerprint[:16]}… not ready within "
+                f"{timeout}s"
+            )
+        if ticket.cancelled:
+            raise Cancelled("ticket was cancelled")
+        if flight.exc is not None:
+            raise flight.exc
+        ticket._result = flight.result
+        return flight.result
+
+    def get(
+        self,
+        request: ProductRequest,
+        *,
+        timeout: Optional[float] = None,
+        priority: int = 1,
+        client: str = "anon",
+        deadline_s: Optional[float] = None,
+    ) -> Tuple[Dict, np.ndarray]:
+        """Synchronous convenience: ``submit`` + ``result``."""
+        return self.result(
+            self.submit(request, priority=priority, client=client,
+                        deadline_s=deadline_s),
+            timeout=timeout,
+        )
+
+    def cancel(self, ticket: Ticket) -> bool:
+        """Withdraw a ticket.  The LAST ticket of a still-queued flight
+        cancels the underlying job and releases its queue slot; a flight
+        whose reduction is already running completes anyway (its product
+        is cached for the next asker).  Returns True when the ticket was
+        withdrawn before completion."""
+        with self._lock:
+            if ticket.cancelled or ticket._result is not None:
+                return False
+            flight = ticket._flight
+            if flight is None or flight.done.is_set():
+                return False
+            ticket.cancelled = True
+            if ticket in flight.tickets:
+                flight.tickets.remove(ticket)
+            if flight.tickets or flight.job is None:
+                return True
+            job = flight.job
+        if self.scheduler.cancel(job):
+            self._finish(ticket.fingerprint, flight,
+                         exc=Cancelled("all tickets cancelled"))
+        return True
+
+    # -- reporting / teardown ---------------------------------------------
+    def stats(self) -> Dict[str, object]:
+        """Serving counters + cache counters + queue-wait percentiles —
+        the ``serve-bench`` CLI's report body."""
+        with self._lock:
+            out: Dict[str, object] = dict(self.counts)
+            out["inflight"] = len(self._flights)
+        cache = self.cache.stats()
+        out["cache"] = cache
+        served = cache["hit.ram"] + cache["hit.disk"]
+        total = served + cache["miss"]
+        out["hit_rate"] = round(served / total, 4) if total else 0.0
+        out["queue_wait"] = self.scheduler.wait_percentiles()
+        out["budget"] = self.scheduler.effective_budget()
+        return out
+
+    def close(self, timeout: Optional[float] = 30.0) -> None:
+        self.scheduler.close(timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -4,7 +4,6 @@ time — sleeps and clocks are injectable), transparent transient-I/O
 recovery in the guppi/fbh5 layers, the WorkerPool re-dispatch path, and
 the per-host circuit breaker."""
 
-import io
 import threading
 import time
 

@@ -17,7 +17,6 @@ from blit.io import (
     read_fil_data,
     read_fil_header,
     write_fil,
-    write_raw,
 )
 from blit.io.guppi import block_ntime
 

@@ -49,6 +49,67 @@ class TestMetadata:
         assert callable(main)
 
 
+class TestPublicSurface:
+    """The serving layer's public names are part of the package contract
+    (ISSUE 3 satellite): pinned here so a refactor that drops or renames
+    them fails loudly."""
+
+    SERVE_EXPORTS = (
+        "ProductService",
+        "ProductRequest",
+        "ProductCache",
+        "Scheduler",
+        "Overloaded",
+    )
+
+    def test_top_level_reexports_serve_layer(self):
+        import blit
+        import blit.serve
+
+        for name in self.SERVE_EXPORTS:
+            assert getattr(blit, name) is getattr(blit.serve, name), name
+            assert name in blit.__all__
+
+    def test_serve_module_surface(self):
+        import blit.serve
+
+        expected = {
+            "Cancelled", "Job", "Overloaded", "ProductCache",
+            "ProductRequest", "ProductService", "Scheduler", "Ticket",
+            "fingerprint_for", "reduction_fingerprint",
+        }
+        assert set(blit.serve.__all__) == expected
+        for name in expected:
+            assert callable(getattr(blit.serve, name)), name
+
+    def test_serve_package_ships(self):
+        with open(os.path.join(REPO, "pyproject.toml"), "rb") as f:
+            tool = tomllib.load(f)["tool"]["setuptools"]
+        assert "blit.serve" in tool["packages"]
+
+    def test_unknown_attribute_still_raises(self):
+        import blit
+
+        with pytest.raises(AttributeError):
+            blit.definitely_not_a_thing  # noqa: B018 — the access IS the test
+
+
+class TestLintConfig:
+    """The ruff CI job (ISSUE 3 satellite) must keep its checked-in
+    config: job present in the workflow, config present in pyproject."""
+
+    def test_ruff_config_checked_in(self):
+        with open(os.path.join(REPO, "pyproject.toml"), "rb") as f:
+            tool = tomllib.load(f)["tool"]
+        assert "F" in tool["ruff"]["lint"]["select"]
+        assert "E9" in tool["ruff"]["lint"]["select"]
+
+    def test_ci_runs_ruff(self):
+        with open(os.path.join(REPO, ".github", "workflows", "ci.yml")) as f:
+            ci = f.read()
+        assert "ruff check" in ci
+
+
 class TestInstalledSurface:
     def test_module_invocation(self):
         # `python -m blit --help` works from any cwd (the console script

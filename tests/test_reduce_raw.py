@@ -1,7 +1,6 @@
 """Distributed RAW → filterbank reduction through the orchestration API
 (gbt.reduce_raw → workers.reduce_raw → pipeline), per BASELINE configs 1-2."""
 
-import numpy as np
 import pytest
 
 jax = pytest.importorskip("jax")

@@ -54,6 +54,33 @@ def test_cursor_sidecar_paths_in_lockstep():
     assert _cursor_path("/x/y.h5") == ReductionCursor.path_for("/x/y.h5")
 
 
+def test_cursor_matches_is_member_order_insensitive(tmp_path):
+    # Regression (ISSUE 3 satellite): a multi-file scan sequence is the
+    # same recording whatever order a glob listed its members in —
+    # open_raw sorts members before reading — so a cursor recorded under
+    # one ordering must match a resume (and a cache fingerprint) under
+    # another.  Before the fix, matches() compared the path/stat lists
+    # positionally and any reordering forced a spurious fresh start.
+    paths = []
+    for i in range(3):
+        p = str(tmp_path / f"x.{i:04d}.raw")
+        synth_raw(p, nblocks=1, obsnchan=2, ntime_per_block=256, seed=i)
+        paths.append(p)
+    red = make_red()
+    size, mtime_ns = ReductionCursor.stat_raw(paths)
+    cur = ReductionCursor(paths, red.nfft, red.ntap, red.nint, red.stokes,
+                          window=red.window, raw_size=size,
+                          raw_mtime_ns=mtime_ns)
+    assert cur.matches(red, paths)
+    assert cur.matches(red, list(reversed(paths)))
+    assert cur.matches(red, [paths[1], paths[2], paths[0]])
+    # Still a real identity check: a different member set must NOT match.
+    assert not cur.matches(red, paths[:2])
+    other = str(tmp_path / "x.0003.raw")
+    synth_raw(other, nblocks=1, obsnchan=2, ntime_per_block=256, seed=9)
+    assert not cur.matches(red, [paths[0], paths[1], other])
+
+
 class TestWriterDurability:
     """ResumableFBH5Writer's own contract, driven directly."""
 

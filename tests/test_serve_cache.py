@@ -1,0 +1,285 @@
+"""Two-tier content-addressed product cache (blit/serve/cache.py; ISSUE 3):
+fingerprint stability (incl. member-order insensitivity — the cache-key
+contract), RAM-tier LRU byte budgeting, disk-tier atomic publish +
+corrupt-entry eviction, publish fault drills, and the concurrent-access
+torn-entry guarantees (ISSUE 3 satellite)."""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from blit import faults
+from blit.observability import Timeline
+from blit.serve.cache import (
+    ProductCache,
+    reduction_fingerprint,
+)
+from blit.testing import make_fil_header, make_spectra
+
+
+@pytest.fixture(autouse=True)
+def clean_faults():
+    faults.clear()
+    faults.reset_counters()
+    yield
+    faults.clear()
+    faults.reset_counters()
+
+
+@pytest.fixture
+def raw_files(tmp_path):
+    paths = []
+    for i in range(3):
+        p = str(tmp_path / f"m.{i:04d}.raw")
+        with open(p, "wb") as f:
+            f.write(bytes([i]) * (100 + i))
+        paths.append(p)
+    return paths
+
+
+def entry(nsamps=4, nchans=32, seed=0):
+    hdr = make_fil_header(nchans=nchans)
+    data = make_spectra(nsamps, 1, nchans, seed=seed)
+    return hdr, data
+
+
+class TestFingerprint:
+    def test_member_order_insensitive(self, raw_files):
+        a = reduction_fingerprint(raw_files, nfft=256, nint=2)
+        b = reduction_fingerprint(list(reversed(raw_files)), nfft=256, nint=2)
+        assert a == b
+
+    def test_single_path_equals_singleton_list(self, raw_files):
+        assert reduction_fingerprint(
+            raw_files[0], nfft=64, nint=1
+        ) == reduction_fingerprint([raw_files[0]], nfft=64, nint=1)
+
+    def test_every_reducer_knob_is_key_material(self, raw_files):
+        base = dict(nfft=256, nint=2, ntap=4, stokes="I", window="hamming",
+                    fqav_by=1, dtype="float32", fft_method="auto")
+        fp0 = reduction_fingerprint(raw_files, **base)
+        for k, v in [("nfft", 512), ("nint", 4), ("ntap", 8),
+                     ("stokes", "IQUV"), ("window", "hann"), ("fqav_by", 2),
+                     ("dtype", "bfloat16"), ("fft_method", "direct")]:
+            assert reduction_fingerprint(
+                raw_files, **{**base, k: v}
+            ) != fp0, f"changing {k} must change the key"
+
+    def test_changed_bytes_change_the_key(self, raw_files):
+        fp0 = reduction_fingerprint(raw_files, nfft=256, nint=2)
+        with open(raw_files[1], "ab") as f:
+            f.write(b"x")  # size change
+        assert reduction_fingerprint(raw_files, nfft=256, nint=2) != fp0
+
+    def test_missing_member_raises(self, tmp_path):
+        with pytest.raises(OSError):
+            reduction_fingerprint(str(tmp_path / "nope.raw"), nfft=64, nint=1)
+
+    def test_fingerprint_for_pulls_reducer_knobs(self, raw_files):
+        jax = pytest.importorskip("jax")  # noqa: F841 — RawReducer needs it
+        from blit.pipeline import RawReducer
+        from blit.serve.cache import fingerprint_for
+
+        red = RawReducer(nfft=128, nint=2, stokes="I", fqav_by=2)
+        assert fingerprint_for(red, raw_files) == reduction_fingerprint(
+            raw_files, nfft=128, nint=2, ntap=red.ntap, stokes="I",
+            window=red.window, fqav_by=2, dtype=red.dtype,
+            fft_method=red.fft_method,
+        )
+
+
+class TestRamTier:
+    def test_hit_miss_and_promotion_counters(self):
+        tl = Timeline()
+        c = ProductCache(None, ram_bytes=1 << 20, timeline=tl)
+        assert c.get("f" * 64) is None
+        hdr, data = entry()
+        served = c.put("f" * 64, hdr, data)
+        assert not served.flags.writeable
+        got = c.get("f" * 64)
+        assert got is not None and got[2] == "ram"
+        np.testing.assert_array_equal(got[1], data)
+        assert c.stats()["hit.ram"] == 1 and c.stats()["miss"] == 1
+        assert tl.stages["cache.hit.ram"].calls == 1
+        assert tl.stages["cache.miss"].calls == 1
+
+    def test_lru_eviction_by_byte_budget(self):
+        hdr, data = entry(nsamps=4, nchans=32)  # 512 B each
+        c = ProductCache(None, ram_bytes=2 * data.nbytes)
+        c.put("a" * 64, hdr, data)
+        c.put("b" * 64, hdr, make_spectra(4, 1, 32, seed=1))
+        assert c.get("a" * 64) is not None  # refresh a: b is now LRU
+        c.put("c" * 64, hdr, make_spectra(4, 1, 32, seed=2))
+        assert c.get("b" * 64) is None  # evicted
+        assert c.get("a" * 64) is not None
+        assert c.get("c" * 64) is not None
+        assert c.stats()["evict.ram"] == 1
+
+    def test_oversized_entry_skips_ram(self, tmp_path):
+        hdr, data = entry(nsamps=64, nchans=64)
+        c = ProductCache(str(tmp_path / "cache"), ram_bytes=16)
+        c.put("a" * 64, hdr, data)
+        assert c.stats()["ram_entries"] == 0
+        got = c.get("a" * 64)  # still served, from disk
+        assert got is not None and got[2] == "disk"
+
+    def test_later_caller_mutation_cannot_tear_the_entry(self):
+        hdr, data = entry()
+        c = ProductCache(None, ram_bytes=1 << 20)
+        mine = data.copy()
+        c.put("a" * 64, hdr, mine)
+        mine[:] = -1.0  # publisher keeps writing its own buffer
+        np.testing.assert_array_equal(c.get("a" * 64)[1], data)
+
+    def test_hitter_header_mutation_cannot_tear_the_entry(self):
+        # Regression: get() must copy the header out — the array is
+        # frozen, but a by-reference dict would let one caller's edit
+        # corrupt the entry for every later hitter.
+        hdr, data = entry()
+        c = ProductCache(None, ram_bytes=1 << 20)
+        c.put("a" * 64, hdr, data)
+        got_hdr, _, _ = c.get("a" * 64)
+        got_hdr["source_name"] = "TAMPERED"
+        assert c.get("a" * 64)[0]["source_name"] == hdr["source_name"]
+
+
+class TestDiskTier:
+    def test_spill_and_reload_across_instances(self, tmp_path):
+        hdr, data = entry(nsamps=8)
+        root = str(tmp_path / "cache")
+        c1 = ProductCache(root, ram_bytes=1 << 20)
+        c1.put("a" * 64, hdr, data)
+        # Fresh instance (fresh process stand-in): disk hit, then promoted.
+        c2 = ProductCache(root, ram_bytes=1 << 20)
+        got = c2.get("a" * 64)
+        assert got is not None and got[2] == "disk"
+        np.testing.assert_array_equal(got[1], data)
+        assert got[0]["source_name"] == hdr["source_name"]
+        assert c2.get("a" * 64)[2] == "ram"  # promoted
+        assert c2.index() == ["a" * 64]
+
+    def test_publish_is_atomic_no_temp_debris(self, tmp_path):
+        root = str(tmp_path / "cache")
+        c = ProductCache(root, ram_bytes=1 << 20)
+        hdr, data = entry()
+        c.put("a" * 64, hdr, data)
+        assert sorted(os.listdir(root)) == [
+            "a" * 64 + ".h5", "a" * 64 + ".json"
+        ]
+
+    def test_corrupt_entry_evicted_not_served(self, tmp_path):
+        root = str(tmp_path / "cache")
+        c = ProductCache(root, ram_bytes=0)  # force disk reads
+        hdr, data = entry()
+        c.put("a" * 64, hdr, data)
+        # Scribble over the product: the resume_target_ok probe must
+        # catch it, evict BOTH files, and report a miss — never raise,
+        # never serve garbage.
+        with open(c.data_path("a" * 64), "r+b") as f:
+            f.truncate(100)
+        assert c.get("a" * 64) is None
+        assert c.stats()["evict.corrupt"] == 1
+        assert not os.path.exists(c.data_path("a" * 64))
+        assert not os.path.exists(c.meta_path("a" * 64))
+
+    def test_sidecar_is_the_completeness_marker(self, tmp_path):
+        root = str(tmp_path / "cache")
+        c = ProductCache(root, ram_bytes=0)
+        hdr, data = entry()
+        c.put("a" * 64, hdr, data)
+        os.unlink(c.meta_path("a" * 64))  # crash between data and sidecar
+        assert c.get("a" * 64) is None  # incomplete: a miss, not an error
+        assert c.index() == []
+
+    def test_claimed_rows_beyond_file_evicted(self, tmp_path):
+        root = str(tmp_path / "cache")
+        c = ProductCache(root, ram_bytes=0)
+        hdr, data = entry(nsamps=4)
+        c.put("a" * 64, hdr, data)
+        meta = json.load(open(c.meta_path("a" * 64)))
+        meta["nsamps"] = 400  # sidecar claims more than the data holds
+        json.dump(meta, open(c.meta_path("a" * 64), "w"))
+        assert c.get("a" * 64) is None
+        assert c.stats()["evict.corrupt"] == 1
+
+    def test_publish_fault_downgrades_to_ram_only(self, tmp_path):
+        faults.install(faults.FaultRule("cache.publish", "fail", times=1))
+        root = str(tmp_path / "cache")
+        c = ProductCache(root, ram_bytes=1 << 20)
+        hdr, data = entry()
+        served = c.put("a" * 64, hdr, data)
+        # The result in hand is still served (RAM) and no debris landed.
+        np.testing.assert_array_equal(served, data)
+        assert c.get("a" * 64)[2] == "ram"
+        assert os.listdir(root) == []
+        assert c.stats()["publish.error"] == 1
+        assert faults.counters()["fault.cache.publish.fail"] == 1
+
+    def test_disk_byte_budget_evicts_oldest(self, tmp_path):
+        root = str(tmp_path / "cache")
+        hdr, data = entry(nsamps=8, nchans=64)
+        c = ProductCache(root, ram_bytes=0, disk_bytes=3 * data.nbytes)
+        for i, fp in enumerate(["a" * 64, "b" * 64, "c" * 64]):
+            c.put(fp, hdr, make_spectra(8, 1, 64, seed=i))
+            os.utime(c.data_path(fp), ns=(i * 10**9, i * 10**9))
+        c.put("d" * 64, hdr, make_spectra(8, 1, 64, seed=3))
+        assert "a" * 64 not in c.index()  # oldest went first
+        assert c.stats()["evict.disk"] >= 1
+
+
+class TestConcurrentAccess:
+    """ISSUE 3 satellite: the cache under thread pressure."""
+
+    def test_hammering_readers_never_see_a_torn_entry(self, tmp_path):
+        # A tiny RAM budget forces constant eviction while 8 threads mix
+        # gets and puts over 4 distinct products: every successful get
+        # must return EXACTLY the bytes published under that key.
+        nkeys = 4
+        hdr = make_fil_header(nchans=32)
+        expect = {
+            f"{k}" * 64: make_spectra(4, 1, 32, seed=k) for k in range(nkeys)
+        }
+        c = ProductCache(str(tmp_path / "cache"),
+                         ram_bytes=2 * next(iter(expect.values())).nbytes)
+        errors = []
+
+        def worker(tid):
+            rng = np.random.default_rng(tid)
+            for _ in range(60):
+                fp = f"{rng.integers(nkeys)}" * 64
+                got = c.get(fp)
+                if got is None:
+                    c.put(fp, hdr, expect[fp].copy())
+                    continue
+                if got[1].tobytes() != expect[fp].tobytes():
+                    errors.append(fp)
+
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in range(8)]
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+        assert errors == []
+
+    def test_concurrent_same_key_publishes_converge(self, tmp_path):
+        # Many threads publishing the SAME key concurrently (the lost
+        # single-flight race) must leave one complete, readable entry.
+        hdr, data = entry(nsamps=8)
+        c = ProductCache(str(tmp_path / "cache"), ram_bytes=1 << 20)
+        threads = [
+            threading.Thread(
+                target=lambda: c.put("a" * 64, hdr, data.copy())
+            )
+            for _ in range(8)
+        ]
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+        c2 = ProductCache(c.root, ram_bytes=1 << 20)
+        got = c2.get("a" * 64)
+        assert got is not None
+        np.testing.assert_array_equal(got[1], data)
+        assert sorted(os.listdir(c.root)) == [
+            "a" * 64 + ".h5", "a" * 64 + ".json"
+        ]

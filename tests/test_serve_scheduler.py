@@ -1,0 +1,280 @@
+"""Priority scheduler + admission control (blit/serve/scheduler.py;
+ISSUE 3): deterministic overload rejection (never a hang), priority and
+fair-share dispatch order, the health-aware concurrency budget (a tripped
+breaker measurably shrinks admitted concurrency — acceptance criterion),
+queued-job cancellation, and the dispatch fault-injection point."""
+
+import threading
+import time
+
+import pytest
+
+from blit import faults
+from blit.faults import FaultRule, InjectedFault
+from blit.observability import Timeline
+from blit.parallel.pool import WorkerPool
+from blit.serve.scheduler import Cancelled, Overloaded, Scheduler
+
+
+@pytest.fixture(autouse=True)
+def clean_faults():
+    faults.clear()
+    faults.reset_counters()
+    yield
+    faults.clear()
+    faults.reset_counters()
+
+
+class Gate:
+    """A job body that blocks until released, recording its run order."""
+
+    def __init__(self):
+        self.release = threading.Event()
+        self.order = []
+        self.started = threading.Event()
+
+    def job(self, tag):
+        def run():
+            self.started.set()
+            assert self.release.wait(10), "gate never released"
+            self.order.append(tag)
+            return tag
+
+        return run
+
+    def instant(self, tag):
+        def run():
+            self.order.append(tag)
+            return tag
+
+        return run
+
+
+def wait_for(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while not pred():
+        assert time.monotonic() < deadline, "condition never held"
+        time.sleep(0.005)
+
+
+class TestDispatchOrder:
+    def test_priorities_dispatch_lowest_first(self):
+        g = Gate()
+        s = Scheduler(max_concurrency=1, queue_depth=16)
+        blocker = s.submit(g.job("blocker"))
+        wait_for(g.started.is_set)
+        jobs = [s.submit(g.instant(p), priority=p) for p in (2, 0, 1)]
+        g.release.set()
+        for j in jobs:
+            j.result(timeout=10)
+        blocker.result(timeout=10)
+        assert g.order == ["blocker", 0, 1, 2]
+
+    def test_fair_share_round_robin_across_clients(self):
+        # One caller fanning out a burst must not starve another: with
+        # alice's 4 jobs queued ahead of bob's 1, bob still runs second.
+        g = Gate()
+        s = Scheduler(max_concurrency=1, queue_depth=16)
+        blocker = s.submit(g.job("blocker"), client="alice")
+        wait_for(g.started.is_set)
+        jobs = [s.submit(g.instant(f"alice{i}"), client="alice")
+                for i in range(4)]
+        jobs.append(s.submit(g.instant("bob0"), client="bob"))
+        g.release.set()
+        for j in jobs:
+            j.result(timeout=10)
+        blocker.result(timeout=10)
+        assert g.order[0] == "blocker"
+        # Round-robin: alice0, bob0, then alice's remaining backlog.
+        assert g.order[1:3] == ["alice0", "bob0"]
+        assert g.order[3:] == ["alice1", "alice2", "alice3"]
+
+    def test_concurrency_budget_is_respected(self):
+        g = Gate()
+        s = Scheduler(max_concurrency=2, queue_depth=16)
+        jobs = [s.submit(g.job(i)) for i in range(4)]
+        wait_for(lambda: s.running() == 2)
+        assert s.depth() == 2  # the rest stay queued
+        g.release.set()
+        for j in jobs:
+            j.result(timeout=10)
+        assert s.running() == 0
+
+
+class TestAdmissionControl:
+    def test_full_queue_rejects_with_overloaded_not_a_hang(self):
+        g = Gate()
+        s = Scheduler(max_concurrency=1, queue_depth=2)
+        s.submit(g.job("blocker"))
+        wait_for(g.started.is_set)
+        s.submit(g.instant("q1"))
+        s.submit(g.instant("q2"))
+        t0 = time.monotonic()
+        with pytest.raises(Overloaded) as ei:
+            s.submit(g.instant("q3"))
+        assert time.monotonic() - t0 < 1.0  # immediate, not a hang
+        assert ei.value.retry_after_s > 0
+        assert s.counts["rejected"] == 1
+        g.release.set()
+        s.close()
+
+    def test_queue_bound_is_per_priority(self):
+        g = Gate()
+        s = Scheduler(max_concurrency=1, queue_depth=1)
+        s.submit(g.job("blocker"))
+        wait_for(g.started.is_set)
+        s.submit(g.instant("a"), priority=1)
+        with pytest.raises(Overloaded):
+            s.submit(g.instant("b"), priority=1)
+        s.submit(g.instant("c"), priority=0)  # other priority: own bound
+        g.release.set()
+        s.close()
+
+    def test_unmeetable_deadline_rejected_at_the_door(self):
+        g = Gate()
+        s = Scheduler(max_concurrency=1, queue_depth=16)
+        # Seed the service-time estimator with one real completion.
+        s.submit(lambda: time.sleep(0.05)).result(timeout=10)
+        assert s.est_wait_s(1) == 0.0  # empty queue: no wait
+        s.submit(g.job("blocker"))
+        wait_for(g.started.is_set)
+        for i in range(4):
+            s.submit(g.instant(i))
+        est = s.est_wait_s(1)
+        assert est > 0.0
+        with pytest.raises(Overloaded) as ei:
+            s.submit(g.instant("late"), deadline_s=est / 100)
+        assert ei.value.retry_after_s > 0
+        # A patient caller is still admitted.
+        s.submit(g.instant("patient"), deadline_s=60.0)
+        g.release.set()
+        s.close()
+
+    def test_degraded_host_shrinks_admitted_concurrency(self):
+        # Acceptance criterion: a tripped breaker (HostDegraded, PR 2)
+        # must measurably shrink the concurrency budget — half the hosts
+        # degraded halves the admitted parallelism.
+        pool = WorkerPool(["h0", "h1"], backend="local")
+        s = Scheduler(max_concurrency=2, queue_depth=16, pool=pool)
+        assert s.effective_budget() == 2
+        g = Gate()
+        br = pool.workers[0].breaker
+        for _ in range(br.threshold):
+            br.record_failure()
+        assert pool.health()[0]["state"] == "open"
+        assert s.effective_budget() == 1
+        jobs = [s.submit(g.job(i)) for i in range(2)]
+        wait_for(lambda: s.running() == 1)
+        time.sleep(0.05)
+        assert s.running() == 1  # second job held back by the shrunk budget
+        assert s.depth() == 1
+        # Recovery: the breaker re-closing restores the budget and the
+        # held job dispatches on the next completion.
+        br.record_success()
+        assert s.effective_budget() == 2
+        g.release.set()
+        for j in jobs:
+            j.result(timeout=10)
+        s.close()
+        pool.shutdown()
+
+    def test_fully_degraded_pool_still_probes_one_job(self):
+        pool = WorkerPool(["h0", "h1"], backend="local")
+        for w in pool.workers:
+            for _ in range(w.breaker.threshold):
+                w.breaker.record_failure()
+        s = Scheduler(max_concurrency=4, pool=pool)
+        assert s.effective_budget() == 1  # floor: never wedge the queue
+        pool.shutdown()
+
+
+class TestCancellation:
+    def test_cancel_queued_job_releases_its_slot(self):
+        g = Gate()
+        s = Scheduler(max_concurrency=1, queue_depth=1)
+        s.submit(g.job("blocker"))
+        wait_for(g.started.is_set)
+        queued = s.submit(g.instant("queued"))
+        with pytest.raises(Overloaded):
+            s.submit(g.instant("refused"))
+        assert s.cancel(queued)
+        assert queued.state == "cancelled"
+        with pytest.raises(Cancelled):
+            queued.result(timeout=1)
+        replacement = s.submit(g.instant("replacement"))  # slot released
+        g.release.set()
+        assert replacement.result(timeout=10) == "replacement"
+        assert "queued" not in g.order  # never dispatched
+        s.close()
+
+    def test_running_job_cannot_be_cancelled(self):
+        g = Gate()
+        s = Scheduler(max_concurrency=1)
+        j = s.submit(g.job("r"))
+        wait_for(g.started.is_set)
+        assert not s.cancel(j)
+        g.release.set()
+        assert j.result(timeout=10) == "r"
+        s.close()
+
+
+class TestFailuresAndDrills:
+    def test_job_exception_delivered_via_result(self):
+        s = Scheduler(max_concurrency=1)
+
+        def boom():
+            raise ValueError("bad request")
+
+        j = s.submit(boom)
+        with pytest.raises(ValueError, match="bad request"):
+            j.result(timeout=10)
+        assert s.counts["failed"] == 1
+        s.close()
+
+    def test_dispatch_fault_injection_point(self):
+        # BLIT_FAULTS drills reach the serving layer: a sched.dispatch
+        # fail rule kills the dispatched job, keyed by client identity.
+        faults.install(FaultRule("sched.dispatch", "fail", times=1,
+                                 match="victim"))
+        s = Scheduler(max_concurrency=2)
+        ok = s.submit(lambda: "fine", client="bystander")
+        bad = s.submit(lambda: "never", client="victim")
+        assert ok.result(timeout=10) == "fine"
+        with pytest.raises(InjectedFault):
+            bad.result(timeout=10)
+        assert faults.counters()["fault.sched.dispatch.fail"] == 1
+        s.close()
+
+    def test_result_timeout_is_builtin_timeout_error(self):
+        g = Gate()
+        s = Scheduler(max_concurrency=1)
+        j = s.submit(g.job("slow"))
+        wait_for(g.started.is_set)
+        with pytest.raises(TimeoutError):
+            j.result(timeout=0.01)
+        g.release.set()
+        j.result(timeout=10)
+        s.close()
+
+
+class TestObservability:
+    def test_wait_gauges_and_percentiles(self):
+        tl = Timeline()
+        s = Scheduler(max_concurrency=1, timeline=tl)
+        for i in range(5):
+            s.submit(lambda: None).result(timeout=10)
+        s.close()
+        pct = s.wait_percentiles()
+        assert pct["n"] == 5
+        assert 0.0 <= pct["p50"] <= pct["p99"]
+        rep = tl.report()
+        assert "gauges" in rep
+        assert rep["gauges"]["sched.wait_s"]["n"] == 5
+        assert rep["gauges"]["sched.queue_depth"]["n"] == 5
+        assert tl.stages["sched.run"].byte_free  # no byte-invariant breach
+
+    def test_closed_scheduler_refuses_work(self):
+        s = Scheduler(max_concurrency=1)
+        s.close()
+        with pytest.raises(RuntimeError, match="closed"):
+            s.submit(lambda: None)
