@@ -303,7 +303,15 @@ def _run_ingest(config_name: str) -> dict:
                 host_read_gbps,
                 file_bytes / (time.perf_counter() - t0) / 1e9,
             )
-        red.timeline.stages.clear()  # warmup passes don't belong in stages
+        # Discard warmup passes IN PLACE (Timeline.reset) — NOT
+        # stages.clear(): clear() orphans any StageStats object a thread
+        # or captured local still holds, so later byte/second updates
+        # land in objects the report never sees.  That identity bug is
+        # how the seed-era rig reported BENCH_r05's
+        # "stream": {"s": 350.3, "bytes": 0} (ISSUE 4 satellite;
+        # tests/test_outplane.py pins this exact warmup→reset→drain
+        # sequence).
+        red.timeline.reset()
         t0 = time.perf_counter()
         checksum = red.drain(raw)
         elapsed = time.perf_counter() - t0
@@ -321,7 +329,43 @@ def _run_ingest(config_name: str) -> dict:
         np.asarray(y)
         readback_gbps = y.nbytes / (time.perf_counter() - t1) / 1e9
 
+        # Product leg (ISSUE 4): the SAME recording reduced to an actual
+        # on-disk product through the asynchronous output plane — host
+        # read → H2D → compute → D2H readback → write-behind .fil append
+        # all overlapped (blit/outplane.py).  fqav_by=16 is the paper's
+        # reduce-before-the-wire lever: the product (hence the slow-link
+        # readback) shrinks 16x, and the fqav compile is already warm
+        # from the primary leg's fqav16 pass.  The stage table carries
+        # the new readback/write stages and the overlap gauge
+        # (sum of device+readback+write seconds per stream-wall second;
+        # ~1 = serialized — the BENCH_r05 collapse — higher = hidden).
+        product = {}
+        try:
+            redp = RawReducer(nfft=nfft, nint=1, stokes="I",
+                              chunk_frames=chunk_frames, dtype=dtype,
+                              fqav_by=16)
+            t2 = time.perf_counter()
+            redp.reduce_to_file(raw, os.path.join(tmp, "bench.0000.fil"))
+            elp = time.perf_counter() - t2
+            product = {
+                "rig_product_gbps": round(file_bytes / elp / 1e9, 3),
+                "product_config": {
+                    "fqav_by": 16,
+                    "sink": ".fil (async output plane)",
+                    "overlap_efficiency": round(
+                        redp.timeline.overlap_efficiency(), 3
+                    ),
+                    "stages": {
+                        k: {"s": round(v.seconds, 3), "bytes": v.bytes}
+                        for k, v in redp.timeline.stages.items()
+                    },
+                },
+            }
+        except Exception as e:  # noqa: BLE001 — secondary leg must not kill the line
+            product = {"rig_product_error": f"{type(e).__name__}: {e}"}
+
         return {
+            **product,
             # "rig_" prefix: this end-to-end figure is dominated by the dev
             # rig's tunneled host->device link (see the stage table and
             # rig_readback_gbps), NOT by the framework — host_read_gbps and

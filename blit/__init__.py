@@ -19,6 +19,9 @@ Layer map (mirrors SURVEY.md §1, rebuilt TPU-first):
 - ``blit.pipeline``  — GUPPI RAW → high-resolution filterbank reduction driver.
 - ``blit.faults``    — deterministic fault injection + recovery policy
   (transient-I/O retry, circuit breakers, degradation counters).
+- ``blit.outplane``  — the asynchronous output plane: overlapped
+  device→host readback (OutputRotation) and write-behind product sinks
+  (AsyncSink) behind every streaming driver.
 - ``blit.serve``     — the product service layer: priority scheduler with
   admission control, single-flight request coalescing, two-tier
   content-addressed result cache.
@@ -65,6 +68,7 @@ def __getattr__(name):
         "config",
         "testing",
         "faults",
+        "outplane",
         "serve",
     ):
         import importlib

@@ -18,6 +18,11 @@ Commands:
              Replay a zipfian request mix against a ProductService
              (blit/serve) over synthetic RAW inputs and report hit-rate,
              coalesce counts, and p50/p99 queue wait.
+  ingest-bench
+             File→product throughput probe of the asynchronous output
+             plane (blit/outplane): per-stage table with the readback/
+             write stages and the overlap-efficiency gauge, optionally
+             A/B'd against the synchronous path.
 """
 
 from __future__ import annotations
@@ -223,6 +228,74 @@ def _cmd_serve_bench(args: argparse.Namespace) -> int:
         return 1 if errors else 0
 
 
+def _cmd_ingest_bench(args: argparse.Namespace) -> int:
+    """File→product throughput probe for the asynchronous output plane
+    (ISSUE 4): reduce a synthetic RAW recording to a real on-disk product
+    and print the per-stage table — including the new ``readback`` and
+    ``write`` stages — plus the overlap-efficiency gauge, optionally
+    A/B'ing against the fully synchronous path (``--sync-compare``).
+    This is the table an operator reads when a deployment's end-to-end
+    rate collapses below the kernel rate (docs/WORKFLOWS.md "Diagnosing
+    a slow link")."""
+    import os
+    import tempfile
+    import time as _time
+
+    from blit.pipeline import RawReducer
+    from blit.testing import synth_raw
+
+    def run(async_output: bool) -> dict:
+        red = RawReducer(nfft=args.nfft, nint=args.nint,
+                         chunk_frames=args.chunk_frames,
+                         fqav_by=args.fqav, dtype=args.dtype,
+                         async_output=async_output)
+        out = os.path.join(td, "bench_async.fil" if async_output
+                           else "bench_sync.fil")
+        t0 = _time.perf_counter()
+        red.reduce_to_file(raw_path, out)
+        wall = _time.perf_counter() - t0
+        tl = red.timeline
+        return {
+            "async_output": async_output,
+            "wall_s": round(wall, 3),
+            "ingest_gbps": round(file_bytes / wall / 1e9, 3),
+            "overlap_efficiency": round(tl.overlap_efficiency(), 3),
+            "stages": {
+                k: {"calls": v.calls, "s": round(v.seconds, 4),
+                    "bytes": v.bytes}
+                for k, v in sorted(list(tl.stages.items()))
+            },
+            "product_bytes": os.path.getsize(out),
+        }
+
+    with tempfile.TemporaryDirectory(prefix="blit-ingest-bench-") as td:
+        raw_path = os.path.join(td, "bench.raw")
+        # File length leaves exactly the (ntap-1)*nfft PFB tail after the
+        # last chunk so no flush-shape recompile triggers (bench.py rule).
+        ntime = (args.chunks * args.chunk_frames + 3) * args.nfft
+        _, blocks = synth_raw(raw_path, nblocks=args.blocks,
+                              obsnchan=args.nchan,
+                              ntime_per_block=-(-ntime // args.blocks))
+        file_bytes = sum(b.nbytes for b in blocks)
+        # Untimed warmup: compile the channelizer (and fault the product
+        # path's buffers) so the timed legs measure steady-state
+        # streaming, not the one-off jit compile.
+        RawReducer(nfft=args.nfft, nint=args.nint,
+                   chunk_frames=args.chunk_frames, fqav_by=args.fqav,
+                   dtype=args.dtype).reduce_to_file(
+            raw_path, os.path.join(td, "warmup.fil"))
+        legs = [run(True)]
+        if args.sync_compare:
+            legs.append(run(False))
+        report = {"file_bytes": file_bytes, "legs": legs}
+        if len(legs) == 2 and legs[1]["wall_s"] > 0:
+            report["async_speedup"] = round(
+                legs[1]["wall_s"] / max(legs[0]["wall_s"], 1e-9), 3
+            )
+        print(json.dumps(report))
+    return 0
+
+
 def _cmd_info(args: argparse.Namespace) -> int:
     path = args.file
     if path.endswith(".raw") or _looks_like_raw(path):
@@ -332,6 +405,29 @@ def main(argv: Optional[List[str]] = None) -> int:
     pf = sub.add_parser("info", help="print a file's normalized header")
     pf.add_argument("file")
     pf.set_defaults(fn=_cmd_info)
+
+    pg = sub.add_parser(
+        "ingest-bench",
+        help="file→product throughput probe of the async output plane "
+             "(per-stage readback/write table + overlap gauge)",
+    )
+    pg.add_argument("--nfft", type=int, default=1024)
+    pg.add_argument("--nint", type=int, default=1)
+    pg.add_argument("--nchan", type=int, default=4)
+    pg.add_argument("--chunk-frames", type=int, default=8)
+    pg.add_argument("--chunks", type=int, default=8,
+                    help="device chunks in the synthetic recording")
+    pg.add_argument("--blocks", type=int, default=4,
+                    help="RAW blocks the recording is split into")
+    pg.add_argument("--fqav", type=int, default=1,
+                    help="on-device frequency averaging (shrinks the "
+                         "product crossing the readback link)")
+    pg.add_argument("--dtype", default="float32",
+                    choices=["float32", "bfloat16"])
+    pg.add_argument("--sync-compare", action="store_true",
+                    help="also run the fully synchronous output path and "
+                         "report the async speedup")
+    pg.set_defaults(fn=_cmd_ingest_bench)
 
     pb = sub.add_parser(
         "serve-bench",

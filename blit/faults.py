@@ -10,10 +10,16 @@ module holds both halves:
   config/env-driven registry of named injection points threaded through
   the I/O layer (``guppi.read`` / ``guppi.open`` / ``fbh5.write`` /
   ``workers.read``), the stream producer threads (``antenna.produce``),
-  the remote transport (``remote.call``) and the product service layer
+  the remote transport (``remote.call``), the product service layer
   (``cache.publish`` — the disk publish of blit/serve/cache.py;
   ``sched.dispatch`` — the scheduler's dispatch path, keyed by client,
-  blit/serve/scheduler.py).  Modes: ``fail`` (raise
+  blit/serve/scheduler.py) and the asynchronous output plane
+  (``sink.write`` — each write-behind product append on the
+  :class:`blit.outplane.AsyncSink` writer thread; ``sink.flush`` — its
+  flush barrier; both keyed by the product path, and both surfacing
+  writer-THREAD failures as clean consumer-side re-raises — the ISSUE 4
+  drill for a dying disk under an overlapped reduction).  Modes:
+  ``fail`` (raise
   :class:`InjectedFault` — an ``OSError``, so retry paths treat it like
   a flaky NFS read), ``delay`` (injectable sleep), ``truncate`` (short
   read — a *hard* failure the degraded-antenna masking handles) and

@@ -456,6 +456,14 @@ class FBH5Writer(_ChunkStream):
             return
         self._buffer_slab(slab)
 
+    def flush(self) -> None:
+        """Flush libhdf5 buffers to the OS — the write-behind sink's
+        flush barrier hook (:meth:`blit.outplane.AsyncSink.flush`).
+        Does NOT flush a buffered partial bitshuffle chunk row (that
+        happens at :meth:`close`, padded, exactly once)."""
+        if self._h5 is not None:
+            self._h5.flush()
+
     def close(self) -> None:
         """Flush any partial tail chunk, finalize, and rename onto the
         final path.  A failure anywhere in here (tail flush, HDF5 close,

@@ -174,6 +174,14 @@ class FilWriter:
         slab.tofile(self._f)
         self.nsamps += slab.shape[0]
 
+    def flush(self) -> None:
+        """Push appended bytes to the OS — the write-behind sink's flush
+        barrier hook (:meth:`blit.outplane.AsyncSink.flush`).  Durability
+        (fsync) stays the resumable writers' job; the atomic-publish
+        rename on :meth:`close` is this writer's completion marker."""
+        if self._f is not None:
+            self._f.flush()
+
     def close(self) -> None:
         if self._f is None:
             return

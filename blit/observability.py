@@ -17,7 +17,7 @@ import socket
 import time
 from collections import defaultdict
 from dataclasses import dataclass, field
-from typing import Dict, Iterator, Optional
+from typing import Dict, Iterable, Iterator, Optional
 
 
 @dataclass
@@ -97,6 +97,46 @@ class Timeline:
         stage table: levels are point samples, not running totals, so they
         must not pollute the byte-summable stage accounting."""
         self.gauges[name].sample(value)
+
+    def reset(self) -> None:
+        """Zero every stage and gauge IN PLACE, preserving object
+        identity.  This — not ``stages.clear()`` — is how a rig discards
+        warmup passes: ``clear()`` orphans any :class:`StageStats` a
+        concurrent thread (an output-plane readback/writer thread, a feed
+        producer) or a captured local still holds, so their subsequent
+        byte/second updates land in objects the report never sees — the
+        failure shape behind BENCH_r05's ``"stream": {"s": 350.3,
+        "bytes": 0}`` (ISSUE 4 satellite; tests/test_outplane.py pins the
+        rig sequence)."""
+        for s in list(self.stages.values()):
+            s.calls = 0
+            s.seconds = 0.0
+            s.bytes = 0
+        for g in list(self.gauges.values()):
+            g.last = g.lo = g.hi = 0.0
+            g.n = 0
+
+    def overlap_efficiency(self, wall: str = "stream",
+                           work: Iterable[str] = ("device", "readback",
+                                                  "write")) -> float:
+        """Record + return the output plane's overlap gauge
+        (``overlap.<wall>``): seconds of per-stage work retired per
+        wall-clock second of the ``wall`` stage.
+
+        ≈ 1.0 means the plane ran serialized (the wall clock paid for
+        every stage in full — the synchronous-output shape BENCH_r05
+        measured); → N means N stages fully hid behind each other.
+        *Below* 1.0 the wall stage is dominated by something the work
+        stages don't time — usually the host read leg (``ingest``) or
+        dispatch gaps.  0.0 when the wall stage never ran.  See
+        docs/WORKFLOWS.md "Diagnosing a slow link"."""
+        wall_s = self.stages[wall].seconds if wall in self.stages else 0.0
+        work_s = sum(
+            self.stages[k].seconds for k in work if k in self.stages
+        )
+        eff = work_s / wall_s if wall_s > 0 else 0.0
+        self.gauge(f"overlap.{wall}", eff)
+        return eff
 
     def report(self, include_faults: bool = False) -> Dict[str, Dict]:
         out = {}

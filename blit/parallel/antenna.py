@@ -377,7 +377,12 @@ class Window:
 
     The consumer MUST call :meth:`release` once nothing still reads the
     window — in practice, after the device compute that consumed it has
-    synchronized (the streaming drivers' lag-1 pattern).  ``arrays`` may
+    synchronized: the streaming drivers hand ``release`` to the output
+    plane's readback thread as the ``on_consumed`` hook
+    (:meth:`blit.outplane.OutputRotation.put`) or release via the shared
+    :class:`blit.outplane.FoldInFlight` lag bookkeeping, so the call may
+    arrive from a thread other than the iterator's (the rotation's slot
+    accounting is lock-guarded for exactly this).  ``arrays`` may
     alias the slot's host buffers until then (CPU backends transfer
     zero-copy when alignment allows), so a released window's arrays must
     not be read again; an unreleased window back-pressures the producer

@@ -293,6 +293,7 @@ def beamform_stream(
     nint: int = 1,
     layout: str = "antenna",
     timeline=None,
+    stall_timeout_s=None,
 ):
     """Stream detected tied-array beam powers over a windowed feed
     (:class:`blit.parallel.antenna.AntennaStream`) — the arbitrarily-
@@ -313,56 +314,56 @@ def beamform_stream(
     chunk rule :class:`blit.pipeline.RawReducer` applies via
     ``chunk_frames``.
 
-    Pipelining: window ``w`` dispatches asynchronously; ``w-1``'s wait +
-    readback happen after the feed transferred ``w`` (its producer thread
-    is reading ``w+1`` behind that) — host reads, transfer and compute
-    overlap at ``prefetch_depth`` windows of host memory.
+    Pipelining rides the shared output plane (blit/outplane.py, ISSUE 4):
+    window ``w`` dispatches asynchronously and its device output goes to
+    the :class:`~blit.outplane.OutputRotation` readback thread, which
+    waits out the collectives and fetches the power slab while this
+    thread dispatches ``w+1`` and the feed's producer reads ``w+2`` —
+    host read, H2D transfer, compute and D2H readback all overlap.  A
+    window's host slot refills the moment its compute synchronized (the
+    ``on_consumed`` hook), exactly the old lag-1 release point.
 
     Stage timings land in ``timeline``: ``dispatch`` (async), ``device``
-    (lag-synchronized wait on a window's collectives), ``readback``
-    (device→host slab fetch).
+    (readback-thread wait on a window's collectives), ``readback``
+    (device→host slab fetch, bytes).
     """
-    import numpy as _np
-
     from blit.observability import Timeline
+    from blit.outplane import OutputRotation
 
     tl = timeline if timeline is not None else Timeline()
-
-    def finish(item):
-        win, out = item
-        with tl.stage("device", byte_free=True):
-            out.block_until_ready()
-        with tl.stage("readback"):
-            slab = _np.asarray(out)
-        tl.stages["readback"].bytes += slab.nbytes
-        # The window's compute is synchronized: its host slot (which the
-        # arrays may alias — Window.release contract) can refill now.
-        win.release()
-        return slab
-
-    pending = None
-    for win in feed:
-        if win.ntime % nint:
-            raise ValueError(
-                f"window {win.index} holds {win.ntime} samples — not a "
-                f"whole number of nint={nint} integrations; choose "
-                "window_samples (and span) divisible by nint"
-            )
-        if win.masked:
-            # Degraded continuation (feed masked a failed antenna): the
-            # accumulated powers carry its zero weight; flag it in the
-            # driver's per-window stage tables too.
-            tl.count("masked_antennas", len(win.masked))
-        with tl.stage("dispatch", byte_free=True):
-            out = beamform(
-                win.arrays, weights, mesh=mesh, axis=axis, nint=nint,
-                detect=True, layout=layout,
-            )
-        if pending is not None:
-            yield finish(pending)
-        pending = (win, out)
-    if pending is not None:
-        yield finish(pending)
+    # depth=2 reproduces the old lag-1 overlap: put(window w) returns
+    # once w-1's slab is fetched, leaving w in un-synchronized flight
+    # while this thread dispatches w+1 — and a window's feed slot frees
+    # at its sync (before the fetch), so the double-buffered feed
+    # (prefetch_depth=2) always has a slot free when the consumer asks
+    # for the next window.
+    rot = OutputRotation(depth=2, timeline=tl, reuse=False,
+                         name="blit-bf-readback",
+                         stall_timeout_s=stall_timeout_s)
+    try:
+        for win in feed:
+            if win.ntime % nint:
+                raise ValueError(
+                    f"window {win.index} holds {win.ntime} samples — not a "
+                    f"whole number of nint={nint} integrations; choose "
+                    "window_samples (and span) divisible by nint"
+                )
+            if win.masked:
+                # Degraded continuation (feed masked a failed antenna): the
+                # accumulated powers carry its zero weight; flag it in the
+                # driver's per-window stage tables too.
+                tl.count("masked_antennas", len(win.masked))
+            with tl.stage("dispatch", byte_free=True):
+                out = beamform(
+                    win.arrays, weights, mesh=mesh, axis=axis, nint=nint,
+                    detect=True, layout=layout,
+                )
+            for slab in rot.put(out, on_consumed=win.release):
+                yield slab.data
+        for slab in rot.drain():
+            yield slab.data
+    finally:
+        rot.close()
 
 
 def beamform_accumulate(
@@ -385,32 +386,33 @@ def beamform_accumulate(
     import jax as _jax
 
     from blit.observability import Timeline
+    from blit.outplane import FoldInFlight
 
     tl = timeline if timeline is not None else Timeline()
     acc = None
-    prev = None
+    flight = FoldInFlight(tl, depth=1)
     add = _jax.jit(lambda a, p: a + p, donate_argnums=0)
     for win in feed:
         if win.masked:
             tl.count("masked_antennas", len(win.masked))
-        if prev is not None:
-            # Lag-1: wait for the previous window's fold (its power output
-            # implies its input was consumed), then recycle its slot.
-            with tl.stage("device", byte_free=True):
-                prev[1].block_until_ready()
-            prev[0].release()
+        # Lag-1 (shared FoldInFlight core, ISSUE 4): wait for the previous
+        # window's fold (its power output implies its input was consumed)
+        # and recycle its slot BEFORE dispatching the next fold.
+        flight.make_room()
         with tl.stage("dispatch", byte_free=True):
             p = beamform(
                 win.arrays, weights, mesh=mesh, axis=axis, nint=win.ntime,
                 detect=True, layout=layout,
             )
             acc = p if acc is None else add(acc, p)
-        prev = (win, p)
+        flight.admit(win, p)
     if acc is None:
         raise ValueError("beamform_accumulate: feed yielded no windows")
     with tl.stage("device", byte_free=True):
         acc.block_until_ready()
-    prev[0].release()
+    # The terminal sync above proved every fold complete — release the
+    # tail without a second wait.
+    flight.drain(synced=True)
     return acc
 
 

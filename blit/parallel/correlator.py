@@ -419,9 +419,11 @@ def correlate_stream(
         raise ValueError(
             f"coeffs shape {coeffs.shape} != (ntap={ntap}, nfft={nfft})"
         )
+    from blit.outplane import FoldInFlight
+
     tl = timeline if timeline is not None else Timeline()
     accr = acci = None
-    prev = None
+    flight = FoldInFlight(tl, depth=1)
     for win in feed:
         if win.masked:
             # Degraded continuation: the band-sharded accumulator folds
@@ -430,14 +432,13 @@ def correlate_stream(
             # (``masked_antennas`` / header ``_masked_antennas``).
             tl.count("masked_antennas", len(win.masked))
         vr, vi = win.arrays
-        if accr is not None:
-            # Lag-1 sync: wait for window w-1's fold only now — the feed
-            # already moved window w and is reading w+1 behind it.  The
-            # synced fold consumed w-1's arrays, so its slot can refill
-            # (Window.release contract).
-            with tl.stage("device", byte_free=True):
-                jax.block_until_ready(accr)
-            prev.release()
+        # Lag-1 sync (shared FoldInFlight core, ISSUE 4): wait for window
+        # w-1's fold only now — the feed already moved window w and is
+        # reading w+1 behind it.  The synced fold consumed w-1's arrays,
+        # so its slot can refill (Window.release contract).  Must happen
+        # BEFORE the next dispatch: _accum_vis donates the accumulator,
+        # and a donated token can no longer be waited on.
+        flight.make_room()
         with tl.stage("dispatch", byte_free=True):
             if accr is None:
                 accr, acci = _window_vis(
@@ -448,7 +449,7 @@ def correlate_stream(
                     accr, acci, vr, vi, coeffs,
                     mesh=mesh, vis_layout=vis_layout,
                 )
-        prev = win
+        flight.admit(win, accr)
     if accr is None:
         raise ValueError("correlate_stream: feed yielded no windows")
     with tl.stage("device", byte_free=True):
@@ -456,7 +457,10 @@ def correlate_stream(
             accr, acci, mesh=mesh, vis_layout=vis_layout
         )
         jax.block_until_ready((visr, visi))
-    prev.release()
+    # The finish fetch just proved every fold complete — release the last
+    # window without the old second sync of the accumulator (ISSUE 4:
+    # "double sync today").
+    flight.drain(synced=True)
     return visr, visi
 
 

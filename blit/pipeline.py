@@ -139,7 +139,11 @@ class BufferRotation:
             target=self._run, name=name, daemon=True
         )
         self._started = False
-        self._held = 0  # slots yielded to the consumer, not yet released
+        # Slots yielded to the consumer, not yet released.  Lock-guarded:
+        # with the async output plane (blit/outplane.py) releases arrive
+        # from the readback thread while the consumer thread increments.
+        self._held = 0
+        self._held_lock = threading.Lock()
         self._beat = time.monotonic()  # last producer progress
 
     def _run(self) -> None:
@@ -169,7 +173,8 @@ class BufferRotation:
 
     # -- consumer side ----------------------------------------------------
     def release(self, slot: int) -> None:
-        self._held -= 1
+        with self._held_lock:
+            self._held -= 1
         self._free.put(slot)
 
     def slots(self) -> Iterator[Tuple[int, object]]:
@@ -213,7 +218,8 @@ class BufferRotation:
                 slot, payload = item
                 if slot is _ROT_ERR:
                     raise payload
-                self._held += 1
+                with self._held_lock:
+                    self._held += 1
                 yield slot, payload
         finally:
             self.close()
@@ -266,15 +272,31 @@ class RawReducer:
     dtype: str = "float32"
     # Output frames per device call; rounded up to a multiple of nint.
     chunk_frames: Optional[int] = None
-    # Per-stage timing/byte registry ("ingest" / "device" / "stream").
+    # Per-stage timing/byte registry ("ingest" / "state" / "stream" on the
+    # source side; "dispatch" / "device" / "readback" / "write" on the
+    # output plane — see blit/outplane.py).
     timeline: Timeline = field(default_factory=Timeline)
     # When set, a JAX profiler trace (TensorBoard/Perfetto readable) wraps
     # every streaming run — SURVEY.md §5 "traces around ingest + kernels".
     trace_logdir: Optional[str] = None
+    # Asynchronous output plane (ISSUE 4): device outputs are read back on
+    # a dedicated thread (device→host overlaps the next chunk's compute)
+    # and file products are written write-behind through an AsyncSink.
+    # Products are byte-identical either way (tests/test_outplane.py);
+    # False — or BLIT_SYNC_OUTPUT=1 in the environment — restores the
+    # fully synchronous per-chunk path (the A/B lever and drill escape
+    # hatch).
+    async_output: bool = True
+    # Producer-progress watchdog for the output plane's readback/writer
+    # threads (None = wait forever), the BufferRotation stall_timeout_s
+    # twin on the result side.
+    output_stall_timeout_s: Optional[float] = None
 
     def __post_init__(self):
         import jax.numpy as jnp
 
+        if os.environ.get("BLIT_SYNC_OUTPUT"):
+            self.async_output = False
         self._output_frames = 0
         # Chunk-buffer cache: streams on the same reducer reuse (already
         # page-faulted) rotation buffers — first-touch faults on GB-sized
@@ -347,18 +369,126 @@ class RawReducer:
         skipping that many samples reproduces the remaining frames
         bit-identically (the resume path of :meth:`reduce_resumable`).
 
-        While chunk ``i`` computes and reads back, the producer thread is
-        already filling the next chunk buffer from the file (module
-        docstring: pipelined ingest).
+        While chunk ``i`` computes, the producer thread is already filling
+        the next chunk buffer from the file (module docstring: pipelined
+        ingest) and — on the default async output plane — the readback
+        thread is fetching chunk ``i-1``'s product, so host read, compute
+        and device→host readback all overlap.  Yielded slabs are the
+        caller's to keep (never recycled under it); slab VALUES are
+        byte-identical to the synchronous path's.
         """
         with profile_trace(self.trace_logdir):
-            for chunk in self._chunks(raw, skip_frames):
-                try:
-                    out = self._run_chunk(chunk.view)
-                finally:
-                    chunk.release()
+            if not self.async_output:
+                for chunk in self._chunks(raw, skip_frames):
+                    try:
+                        out = self._run_chunk(chunk.view)
+                    finally:
+                        chunk.release()
+                    self._output_frames += chunk.frames
+                    yield out
+                return
+            for slab in self._stream_async(raw, skip_frames, reuse=False):
+                data = slab.data
+                slab.release()
+                yield data
+
+    def _stream_async(self, raw: GuppiRaw, skip_frames: int,
+                      reuse: bool) -> Iterator["object"]:
+        """The overlapped streaming core behind :meth:`stream` and
+        :meth:`_pump`: async-dispatch each chunk, hand the in-flight
+        output to an :class:`blit.outplane.OutputRotation` readback
+        thread, and yield :class:`~blit.outplane.OutputSlab` handles in
+        stream order.  ``reuse=True`` recycles host slabs through the
+        rotation's bounded ring (callers must release only after the
+        slab's bytes are consumed — the AsyncSink wiring); ``reuse=False``
+        yields caller-owned arrays (the public :meth:`stream` contract).
+
+        In-flight arithmetic (the :meth:`drain` lag window, one thread
+        over): with readback depth ``d``, ``put(chunk_w)`` returns once
+        chunk ``w-(d-1)`` has been fetched — chunk ``w`` stays in
+        un-synchronized flight while the consumer dispatches ``w+1``, so
+        compute and readback overlap.  Un-synced dispatches pin their
+        ingest slots (released at ``block_until_ready``, before the
+        fetch), so the chunk rotation runs one slot wider
+        (``extra_slots=1``) to keep a slot free for the producer's
+        read-ahead.
+        """
+        import jax
+
+        from blit.outplane import OutputRotation
+
+        rot = OutputRotation(
+            depth=max(2, self.prefetch_depth),
+            timeline=self.timeline, reuse=reuse, name="blit-readback",
+            stall_timeout_s=self.output_stall_timeout_s,
+        )
+        try:
+            for chunk in self._chunks(raw, skip_frames, extra_slots=1):
+                with self.timeline.stage("dispatch", byte_free=True):
+                    out = channelize(
+                        jax.numpy.asarray(chunk.view), self._coeffs,
+                        **self._channelize_kw,
+                    )
                 self._output_frames += chunk.frames
-                yield out
+                for slab in rot.put(out, nbytes=chunk.view.nbytes,
+                                    on_consumed=chunk.release):
+                    yield slab
+            # The chunker's "stream" stage closed when its generator
+            # exhausted above; the readback tail it no longer covers is
+            # still streaming wall time — account it into the same stage
+            # (sequentially, so no double count).
+            t0 = time.perf_counter()
+            for slab in rot.drain():
+                yield slab
+            self.timeline.stages["stream"].seconds += time.perf_counter() - t0
+        finally:
+            rot.close()
+
+    def _pump(self, raw: GuppiRaw, writer, skip_frames: int = 0) -> int:
+        """Drive the full reduction chain into a product writer — host
+        read → H2D → compute → D2H → disk write, every leg on its own
+        thread (ingest producer / main dispatch / readback / sink) with
+        back-pressure end to end — and finalize the writer.  Returns the
+        spectra written.  On error the writer is ``abort()``ed (its own
+        crash contract: ``.partial`` dropped, resumable file + cursor
+        kept) and the error re-raised.  The synchronous fallback
+        (``async_output=False``) keeps the seed's serialized shape for
+        A/B drills."""
+        if not self.async_output:
+            try:
+                # stream() opens the profiler trace itself on this path.
+                for slab in self.stream(raw, skip_frames=skip_frames):
+                    writer.append(np.ascontiguousarray(slab))
+                writer.close()
+            except BaseException:
+                writer.abort()
+                raise
+            return writer.nsamps
+
+        from blit.outplane import AsyncSink
+
+        sink = AsyncSink(
+            writer, depth=max(2, self.prefetch_depth),
+            timeline=self.timeline,
+            stall_timeout_s=self.output_stall_timeout_s,
+        )
+        try:
+            with profile_trace(self.trace_logdir):
+                for slab in self._stream_async(raw, skip_frames,
+                                               reuse=True):
+                    sink.append(slab.data, release=slab.release)
+                # Final flush barrier + writer finalization; the write
+                # tail is streaming wall time like the readback tail.
+                t0 = time.perf_counter()
+                sink.close()
+                self.timeline.stages["stream"].seconds += (
+                    time.perf_counter() - t0
+                )
+        except BaseException:
+            sink.abort()
+            raise
+        self.timeline.overlap_efficiency()
+        return sink.nsamps
 
     def _producer(
         self,
@@ -443,14 +573,21 @@ class RawReducer:
                 rot.emit(cur, (frames, (frames + ntap - 1) * nfft))
 
     def _chunks(
-        self, raw: GuppiRaw, skip_frames: int = 0
+        self, raw: GuppiRaw, skip_frames: int = 0, extra_slots: int = 0
     ) -> Iterator["_Chunk"]:
         """The pipelined chunker behind :meth:`stream` / :meth:`drain`:
         yields :class:`_Chunk` handles in stream order.  The caller MUST
         ``release()`` every chunk once nothing (host or device) still reads
         its buffer; the producer blocks on released buffers to read ahead.
+
+        ``extra_slots`` widens the rotation beyond ``prefetch_depth`` —
+        the async output plane holds one chunk in un-synchronized flight
+        on top of the one being dispatched, and the producer needs a
+        slot free beyond those to keep reading (and to keep the
+        rotation's all-slots-held starvation heuristic a true bug
+        signal rather than a transient of deeper pipelining).
         """
-        nbufs = max(2, self.prefetch_depth)
+        nbufs = max(2, self.prefetch_depth) + max(0, extra_slots)
         bufs: List[Optional[np.ndarray]] = [None] * nbufs
         rot = BufferRotation(
             nbufs,
@@ -578,13 +715,11 @@ class RawReducer:
 
             raw, hdr = self._open_validated(raw_src)
             nif = STOKES_NIF[self.stokes]
-            with FBH5Writer(
+            w = FBH5Writer(
                 out_path, hdr, nifs=nif, nchans=hdr["nchans"],
                 compression=compression, chunks=chunks,
-            ) as w:
-                for slab in self.stream(raw):
-                    w.append(np.ascontiguousarray(slab))
-            hdr["nsamps"] = w.nsamps
+            )
+            hdr["nsamps"] = self._pump(raw, w)
             return hdr
         if compression is not None:
             raise ValueError(".fil products are uncompressed; compression "
@@ -601,10 +736,8 @@ class RawReducer:
         # data loss for consumers that treat existence as completion).
         # Resumable partial products are reduce_resumable's job — there the
         # cursor sidecar marks incompleteness.
-        with FilWriter(out_path, hdr, nif, hdr["nchans"]) as w:
-            for slab in self.stream(raw):
-                w.append(slab)
-        hdr["nsamps"] = w.nsamps
+        w = FilWriter(out_path, hdr, nif, hdr["nchans"])
+        hdr["nsamps"] = self._pump(raw, w)
         return hdr
 
     def reduce_resumable(self, raw_src: RawSource, out_path: str,
@@ -693,14 +826,12 @@ class RawReducer:
             w = ResumableFilWriter(
                 out_path, hdr, nif, hdr["nchans"], start_rows, self.nint, cur
             )
-        try:
-            for slab in self.stream(raw, skip_frames=start_rows * self.nint):
-                w.append(slab)
-            w.close()
-        except BaseException:
-            w.abort()  # file + cursor stay: the resume point
-            raise
-        hdr["nsamps"] = w.nsamps
+        # _pump aborts the writer on error — file + cursor stay as the
+        # resume point (the writer's own crash contract); under the async
+        # plane the cursor may simply sit a few queued-but-unwritten slabs
+        # earlier, which the skip-frames replay re-reduces identically.
+        hdr["nsamps"] = self._pump(raw, w,
+                                   skip_frames=start_rows * self.nint)
         return hdr
 
 

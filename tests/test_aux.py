@@ -121,25 +121,25 @@ class TestResumableReduction:
         raw, red = self._setup(tmp_path)
         out = str(tmp_path / "x.fil")
 
-        # Simulate a crash: stop after the first slab by raising from stream.
+        # Simulate a crash after the first slab landed: fail the
+        # write-behind sink's second append (ISSUE 4 — the async output
+        # plane's realistic crash seam; the writer-thread failure
+        # re-raises clean on the consumer side).
+        from blit import faults
+        from blit.faults import FaultRule
+
         class Boom(Exception):
             pass
 
-        orig_stream = RawReducer.stream
-
-        def crashing_stream(self, raw_, skip_frames=0):
-            for i, slab in enumerate(orig_stream(self, raw_, skip_frames)):
-                if i == 1:
-                    raise Boom()
-                yield slab
-
         red_crash = RawReducer(nfft=64, nint=2, chunk_frames=4)
+        faults.install(FaultRule(point="sink.write", mode="fail",
+                                 after=1, times=-1, exc=Boom))
         try:
-            RawReducer.stream = crashing_stream
             with pytest.raises(Boom):
                 red_crash.reduce_resumable(raw, out)
         finally:
-            RawReducer.stream = orig_stream
+            faults.clear()
+            faults.reset_counters()
 
         cur = ReductionCursor.load(out)
         assert cur is not None and cur.frames_done == 4  # one slab landed

@@ -4,6 +4,7 @@ time — sleeps and clocks are injectable), transparent transient-I/O
 recovery in the guppi/fbh5 layers, the WorkerPool re-dispatch path, and
 the per-host circuit breaker."""
 
+import os
 import threading
 import time
 
@@ -487,3 +488,86 @@ class TestGlobalPoolThreadSafety:
         finally:
             poolmod.reset_pool()
         assert poolmod.current_pool() is None
+
+
+class TestAsyncSinkFaults:
+    """ISSUE 4 satellite: the write-behind output plane's injection
+    points.  A failure on the SINK THREAD must surface as a clean
+    consumer-side re-raise — no orphaned daemon, no valid-looking
+    truncated product, and a resumable partial where the writer is
+    resumable."""
+
+    def _raw(self, tmp_path):
+        p = str(tmp_path / "x.raw")
+        synth_raw(p, nblocks=3, obsnchan=2, ntime_per_block=1024)
+        return p
+
+    def _no_sink_threads(self):
+        import time as _t
+
+        deadline = _t.monotonic() + 5.0
+        while _t.monotonic() < deadline:
+            if not any(t.name in ("blit-sink", "blit-readback")
+                       and t.is_alive() for t in threading.enumerate()):
+                return True
+            _t.sleep(0.02)
+        return False
+
+    def test_sink_write_failure_reraises_and_drops_partial(self, tmp_path):
+        from blit.pipeline import RawReducer
+
+        raw = self._raw(tmp_path)
+        out = str(tmp_path / "x.h5")
+        faults.install(FaultRule("sink.write", "fail", times=1, after=1))
+        with pytest.raises(InjectedFault):
+            RawReducer(nfft=64, nint=2, chunk_frames=4).reduce_to_file(
+                raw, out)
+        # Atomic-publish writers must leave NOTHING: no final product, no
+        # .partial sibling (abort ran on the consumer thread after join).
+        assert not os.path.exists(out)
+        assert not os.path.exists(out + ".partial")
+        assert faults.counters()["fault.sink.write.fail"] == 1
+        assert self._no_sink_threads()
+
+    def test_sink_flush_failure_reraises_at_barrier(self, tmp_path):
+        from blit.pipeline import RawReducer
+
+        raw = self._raw(tmp_path)
+        out = str(tmp_path / "x.fil")
+        # Every append succeeds; the close-time flush barrier fails.
+        faults.install(FaultRule("sink.flush", "fail", times=1))
+        with pytest.raises(InjectedFault):
+            RawReducer(nfft=64, nint=2, chunk_frames=4).reduce_to_file(
+                raw, out)
+        assert not os.path.exists(out)  # never renamed complete
+        assert faults.counters()["fault.sink.flush.fail"] == 1
+        assert self._no_sink_threads()
+
+    def test_sink_failure_keeps_resumable_partial(self, tmp_path):
+        from blit.io.sigproc import read_fil_data
+        from blit.pipeline import RawReducer, ReductionCursor
+
+        raw = self._raw(tmp_path)
+        out = str(tmp_path / "x.fil")
+        faults.install(FaultRule("sink.write", "fail", times=-1, after=1))
+        with pytest.raises(InjectedFault):
+            RawReducer(nfft=64, nint=2, chunk_frames=4).reduce_resumable(
+                raw, out)
+        assert self._no_sink_threads()
+        # The resumable writer's crash artifacts survive the sink abort:
+        # product prefix + cursor = the resume point.
+        cur = ReductionCursor.load(out)
+        assert cur is not None and cur.frames_done == 4
+        assert os.path.exists(out)
+        faults.clear()
+        RawReducer(nfft=64, nint=2, chunk_frames=4).reduce_resumable(
+            raw, out)
+        _, got = read_fil_data(out)
+        _, want = RawReducer(nfft=64, nint=2, chunk_frames=4).reduce(raw)
+        np.testing.assert_array_equal(np.asarray(got), want)
+
+    def test_sink_points_ride_the_drill_grammar(self):
+        rules = faults.parse_spec(
+            "sink.write:fail:2:match=x.h5;sink.flush:delay:delay=0.5")
+        assert rules[0].point == "sink.write" and rules[0].times == 2
+        assert rules[1].point == "sink.flush" and rules[1].delay_s == 0.5

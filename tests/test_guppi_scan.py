@@ -177,24 +177,23 @@ class TestSequenceReduction:
         )
         out = str(tmp_path / "r.fil")
 
+        # Crash after the fifth slab landed: fail the write-behind sink's
+        # sixth append (ISSUE 4 — the async output plane's crash seam).
+        from blit import faults
+        from blit.faults import FaultRule
+
         class Boom(Exception):
             pass
 
-        orig_stream = RawReducer.stream
-
-        def crashing_stream(self, raw_, skip_frames=0):
-            for i, slab in enumerate(orig_stream(self, raw_, skip_frames)):
-                if i == 5:
-                    raise Boom()
-                yield slab
-
         red = RawReducer(nfft=64, nint=1, chunk_frames=4)
+        faults.install(FaultRule(point="sink.write", mode="fail",
+                                 after=5, times=-1, exc=Boom))
         try:
-            RawReducer.stream = crashing_stream
             with pytest.raises(Boom):
                 red.reduce_resumable(stem, out)
         finally:
-            RawReducer.stream = orig_stream
+            faults.clear()
+            faults.reset_counters()
 
         cur = ReductionCursor.load(out)
         # 20 frames done -> the resume skip (20*64 = 1280 samples) lands

@@ -3,6 +3,7 @@ product format (src/gbtworkerfunctions.jl:141-155) must survive a crash the
 way ``.fil`` products do — cursor sidecar, resize-truncate to the last
 durable slab, decoded payload identical to an uninterrupted run."""
 
+import contextlib
 import os
 
 import numpy as np
@@ -10,6 +11,8 @@ import pytest
 
 pytest.importorskip("jax")
 
+from blit import faults  # noqa: E402
+from blit.faults import FaultRule  # noqa: E402
 from blit.io.fbh5 import ResumableFBH5Writer, read_fbh5_data  # noqa: E402
 from blit.pipeline import RawReducer, ReductionCursor  # noqa: E402
 from blit.testing import synth_raw  # noqa: E402
@@ -33,17 +36,20 @@ class Boom(Exception):
     pass
 
 
+@contextlib.contextmanager
 def crash_after(n_slabs):
-    """A RawReducer.stream wrapper that raises after yielding n slabs."""
-    orig = RawReducer.stream
-
-    def crashing(self, raw_, skip_frames=0):
-        for i, slab in enumerate(orig(self, raw_, skip_frames)):
-            if i == n_slabs:
-                raise Boom()
-            yield slab
-
-    return orig, crashing
+    """Crash the product path after exactly ``n_slabs`` slab appends
+    landed, via the write-behind sink's fault-injection point (ISSUE 4:
+    the async output plane moved the append onto a writer thread, so the
+    realistic crash seam is ``sink.write`` — the failure is recorded
+    writer-side and re-raises clean on the consumer thread)."""
+    faults.install(FaultRule(point="sink.write", mode="fail",
+                             after=n_slabs, times=-1, exc=Boom))
+    try:
+        yield
+    finally:
+        faults.clear()
+        faults.reset_counters()
 
 
 def test_cursor_sidecar_paths_in_lockstep():
@@ -189,15 +195,9 @@ class TestReduceResumableH5:
         # flushes a whole bitshuffle chunk — the claim is then non-zero
         # after one slab for both codecs.
         chunks = (2, 1, 128)
-        orig, crashing = crash_after(1)
-        try:
-            RawReducer.stream = crashing
-            with pytest.raises(Boom):
-                make_red().reduce_resumable(raw, out,
-                                            compression=compression,
-                                            chunks=chunks)
-        finally:
-            RawReducer.stream = orig
+        with crash_after(1), pytest.raises(Boom):
+            make_red().reduce_resumable(raw, out, compression=compression,
+                                        chunks=chunks)
         cur = ReductionCursor.load(out)
         assert cur is not None and cur.frames_done == 4  # one slab landed
         assert cur.compression == (compression or "none")
@@ -214,14 +214,8 @@ class TestReduceResumableH5:
         # chunk before the crash: the claim is legitimately 0 and the
         # resume is a clean fresh start, not a corrupt splice.
         out = str(tmp_path / "x.h5")
-        orig, crashing = crash_after(1)
-        try:
-            RawReducer.stream = crashing
-            with pytest.raises(Boom):
-                make_red().reduce_resumable(raw, out,
-                                            compression="bitshuffle")
-        finally:
-            RawReducer.stream = orig
+        with crash_after(1), pytest.raises(Boom):
+            make_red().reduce_resumable(raw, out, compression="bitshuffle")
         assert ReductionCursor.load(out).frames_done == 0
         make_red().reduce_resumable(raw, out, compression="bitshuffle")
         _, want = make_red().reduce(raw)
@@ -229,13 +223,8 @@ class TestReduceResumableH5:
 
     def test_compression_flip_restarts_fresh(self, tmp_path, raw):
         out = str(tmp_path / "x.h5")
-        orig, crashing = crash_after(1)
-        try:
-            RawReducer.stream = crashing
-            with pytest.raises(Boom):
-                make_red().reduce_resumable(raw, out)
-        finally:
-            RawReducer.stream = orig
+        with crash_after(1), pytest.raises(Boom):
+            make_red().reduce_resumable(raw, out)
         # Same config, different codec: identity mismatch -> fresh start
         # (NOT the writer's filter-mismatch refusal, and NOT corruption).
         make_red().reduce_resumable(raw, out, compression="bitshuffle")
@@ -248,26 +237,16 @@ class TestReduceResumableH5:
         # a mismatch must restart fresh — not die on the writer's
         # chunk-mismatch refusal.
         out = str(tmp_path / "x.h5")
-        orig, crashing = crash_after(1)
-        try:
-            RawReducer.stream = crashing
-            with pytest.raises(Boom):
-                make_red().reduce_resumable(raw, out, chunks=(2, 1, 128))
-        finally:
-            RawReducer.stream = orig
+        with crash_after(1), pytest.raises(Boom):
+            make_red().reduce_resumable(raw, out, chunks=(2, 1, 128))
         make_red().reduce_resumable(raw, out)  # default chunks
         _, want = make_red().reduce(raw)
         np.testing.assert_array_equal(read_fbh5_data(out), want)
 
     def test_tampered_raw_restarts_fresh(self, tmp_path, raw):
         out = str(tmp_path / "x.h5")
-        orig, crashing = crash_after(1)
-        try:
-            RawReducer.stream = crashing
-            with pytest.raises(Boom):
-                make_red().reduce_resumable(raw, out)
-        finally:
-            RawReducer.stream = orig
+        with crash_after(1), pytest.raises(Boom):
+            make_red().reduce_resumable(raw, out)
         # Replace the recording with a DIFFERENT valid one (new mtime and
         # payload): the cursor's input identity no longer matches, so the
         # resume must restart fresh and reduce the new bytes.
@@ -305,13 +284,8 @@ class TestCorruptTargetFallback:
         import logging
 
         out = str(tmp_path / "x.h5")
-        orig, crashing = crash_after(1)
-        try:
-            RawReducer.stream = crashing
-            with pytest.raises(Boom):
-                make_red().reduce_resumable(raw, out)
-        finally:
-            RawReducer.stream = orig
+        with crash_after(1), pytest.raises(Boom):
+            make_red().reduce_resumable(raw, out)
         cur = ReductionCursor.load(out)
         assert cur is not None and cur.frames_done > 0
         # Smash the HDF5 superblock — the file no longer opens, but the
