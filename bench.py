@@ -251,6 +251,22 @@ def run_single(config_name: str) -> None:
         result.update(_run_collectives())
     except Exception as e:  # noqa: BLE001 — secondary metric must not kill the line
         result["collectives_error"] = f"{type(e).__name__}: {e}"
+    # Telemetry surfacing (ISSUE 5): span/flight-event counts plus any
+    # process-timeline histograms ride the bench line, and the full fleet
+    # report lands wherever BLIT_TELEMETRY_OUT points (the CI-artifact
+    # hook; no-op when unset).
+    try:
+        from blit import observability
+
+        result["telemetry"] = {
+            "spans": len(observability.tracer().spans()),
+            "flight_events": len(observability.flight_recorder().events()),
+            "hists": observability.process_timeline().report().get(
+                "hists", {}),
+        }
+        observability.maybe_write_report()
+    except Exception as e:  # noqa: BLE001 — telemetry must not kill the line
+        result["telemetry_error"] = f"{type(e).__name__}: {e}"
     print(json.dumps(result))
 
 

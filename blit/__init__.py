@@ -25,6 +25,9 @@ Layer map (mirrors SURVEY.md §1, rebuilt TPU-first):
 - ``blit.serve``     — the product service layer: priority scheduler with
   admission control, single-flight request coalescing, two-tier
   content-addressed result cache.
+- ``blit.observability`` — the telemetry plane: spans/tracer with fan-out
+  context propagation, stage timelines + log-bucketed histograms, fleet
+  telemetry harvest, and the crash/stall flight recorder.
 """
 
 from blit.version import __version__
@@ -70,6 +73,7 @@ def __getattr__(name):
         "faults",
         "outplane",
         "serve",
+        "observability",
     ):
         import importlib
 

@@ -22,7 +22,16 @@ Commands:
              File→product throughput probe of the asynchronous output
              plane (blit/outplane): per-stage table with the readback/
              write stages and the overlap-efficiency gauge, optionally
-             A/B'd against the synchronous path.
+             A/B'd against the synchronous path (and against spans
+             disabled, for the tracing-overhead bound).
+  telemetry  Fleet telemetry (ISSUE 5): harvest per-worker Timelines,
+             fault counters and spans into one per-host report (text /
+             Prometheus exposition / JSON), render a saved report, or
+             run a multi-worker demo reduction that also exports a
+             Perfetto-loadable trace.
+  trace-view Render a flight-recorder dump (written automatically when a
+             stall watchdog trips, a breaker opens, or an agent dies)
+             into a readable incident summary.
 """
 
 from __future__ import annotations
@@ -223,6 +232,9 @@ def _cmd_serve_bench(args: argparse.Namespace) -> int:
             "queue_wait_p50_s": round(qw["p50"], 6),
             "queue_wait_p99_s": round(qw["p99"], 6),
             "cache": stats["cache"],
+            # Latency distributions (ISSUE 5): the bounded histograms the
+            # serving timeline accumulated — tails, not averages.
+            "hists": tl.report().get("hists", {}),
             "errors": errors[:5],
         }))
         return 1 if errors else 0
@@ -265,6 +277,10 @@ def _cmd_ingest_bench(args: argparse.Namespace) -> int:
                     "bytes": v.bytes}
                 for k, v in sorted(list(tl.stages.items()))
             },
+            # Per-chunk latency distributions (out.chunk_latency_s /
+            # out.readback_lag_s — ISSUE 5): the tails behind the stage
+            # sums above.
+            "hists": tl.report().get("hists", {}),
             "product_bytes": os.path.getsize(out),
         }
 
@@ -292,7 +308,93 @@ def _cmd_ingest_bench(args: argparse.Namespace) -> int:
             report["async_speedup"] = round(
                 legs[1]["wall_s"] / max(legs[0]["wall_s"], 1e-9), 3
             )
+        if args.spans_compare:
+            # Tracing-overhead A/B (ISSUE 5 acceptance: always-on spans
+            # must cost <= 1%): interleave spans-on/spans-off legs so slow
+            # drift doesn't masquerade as overhead, and compare the best
+            # wall of each arm (min is the standard noise-floor estimator
+            # for identical repeated work).
+            from blit import observability
+
+            tr = observability.tracer()
+            prev, walls = tr.enabled, {True: [], False: []}
+            try:
+                for _ in range(args.spans_reps):
+                    for enabled in (True, False):
+                        tr.enabled = enabled
+                        walls[enabled].append(run(True)["wall_s"])
+            finally:
+                tr.enabled = prev
+            on, off = min(walls[True]), min(walls[False])
+            report["spans_on_s"] = on
+            report["spans_off_s"] = off
+            report["span_overhead"] = round(on / max(off, 1e-9) - 1.0, 4)
         print(json.dumps(report))
+    return 0
+
+
+def _cmd_telemetry(args: argparse.Namespace) -> int:
+    """Fleet telemetry report (ISSUE 5 tentpole #3).  Three sources:
+    ``--from`` renders a saved report JSON; ``--demo`` runs a real
+    multi-worker ``reduce_to_file`` fan-out over synthetic recordings and
+    harvests the pool (the end-to-end proof: every worker's stage table
+    and fault counters in one per-host report, plus a Perfetto-loadable
+    trace via ``--trace-out``); the default snapshots this process."""
+    import json as _json
+
+    from blit import observability
+
+    if args.from_file:
+        with open(args.from_file) as f:
+            report = _json.load(f)
+    elif args.demo:
+        import os
+        import tempfile
+
+        from blit import workers
+        from blit.parallel.pool import WorkerPool
+        from blit.testing import synth_raw
+
+        n = max(1, args.workers)
+        with tempfile.TemporaryDirectory(prefix="blit-telemetry-") as td:
+            argtuples = []
+            for i in range(n):
+                raw = os.path.join(td, f"demo{i}.raw")
+                synth_raw(raw, nblocks=1, obsnchan=2,
+                          ntime_per_block=(8 + 3) * args.nfft, seed=i)
+                argtuples.append((raw, os.path.join(td, f"demo{i}.fil")))
+            with WorkerPool([f"w{i + 1}" for i in range(n)],
+                            backend=args.backend) as pool:
+                with observability.span("telemetry-demo", workers=n):
+                    pool.run_on(list(range(1, n + 1)), workers.reduce_raw,
+                                argtuples, kwargs={"nfft": args.nfft})
+                report = pool.harvest_telemetry()
+    else:
+        report = observability.local_fleet_report()
+    if args.trace_out:
+        # Works in every source mode: the tracer holds this process's
+        # spans, the report carries any harvested (or saved) ones.
+        observability.tracer().export_chrome(
+            args.trace_out, extra=report.get("spans"))
+        print(f"# trace written to {args.trace_out}", file=sys.stderr)
+    if args.format == "prom":
+        print(observability.render_prometheus(report), end="")
+    elif args.format == "json":
+        print(_json.dumps(report))
+    else:
+        print(observability.render_fleet_text(report))
+    return 0
+
+
+def _cmd_trace_view(args: argparse.Namespace) -> int:
+    """Render a flight-recorder dump into an incident summary."""
+    import json as _json
+
+    from blit.observability import render_flight_dump
+
+    with open(args.dump) as f:
+        doc = _json.load(f)
+    print(render_flight_dump(doc, tail=args.events))
     return 0
 
 
@@ -427,6 +529,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     pg.add_argument("--sync-compare", action="store_true",
                     help="also run the fully synchronous output path and "
                          "report the async speedup")
+    pg.add_argument("--spans-compare", action="store_true",
+                    help="A/B the async leg with spans enabled vs disabled "
+                         "and report the tracing overhead ratio")
+    pg.add_argument("--spans-reps", type=int, default=3,
+                    help="interleaved repetitions per spans-compare arm")
     pg.set_defaults(fn=_cmd_ingest_bench)
 
     pb = sub.add_parser(
@@ -452,6 +559,41 @@ def main(argv: Optional[List[str]] = None) -> int:
     pb.add_argument("--disk-cache", action="store_true",
                     help="enable the disk cache tier (tempdir)")
     pb.set_defaults(fn=_cmd_serve_bench)
+
+    pt = sub.add_parser(
+        "telemetry",
+        help="fleet telemetry report (harvest / render / demo run)",
+    )
+    pt.add_argument("--from", dest="from_file", default=None,
+                    help="render a saved fleet report JSON instead of "
+                         "harvesting")
+    pt.add_argument("--demo", action="store_true",
+                    help="run a multi-worker reduce_to_file fan-out over "
+                         "synthetic recordings and harvest the pool")
+    pt.add_argument("--workers", type=int, default=2,
+                    help="demo pool size")
+    pt.add_argument("--backend", default="thread",
+                    choices=["local", "thread", "process"],
+                    help="demo pool backend")
+    pt.add_argument("--nfft", type=int, default=256)
+    pt.add_argument("--trace-out", default=None,
+                    help="also export the run's spans as Chrome-trace-"
+                         "event JSON (Perfetto-loadable)")
+    pt.add_argument("--format", default="text",
+                    choices=["text", "prom", "json"],
+                    help="report rendering: human text, Prometheus "
+                         "exposition, or raw JSON")
+    pt.set_defaults(fn=_cmd_telemetry)
+
+    pv = sub.add_parser(
+        "trace-view",
+        help="render a flight-recorder dump into an incident summary",
+    )
+    pv.add_argument("dump", help="flight-recorder JSON "
+                                 "(blit-flight-<host>-<pid>-<t>.json)")
+    pv.add_argument("--events", type=int, default=40,
+                    help="how many trailing ring events to show")
+    pv.set_defaults(fn=_cmd_trace_view)
 
     args = p.parse_args(argv)
     return args.fn(args)

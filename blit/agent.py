@@ -229,8 +229,18 @@ def serve(stdin=None, stdout=None) -> None:
             # Decoded fine but not the (fn_path, args, kwargs) shape.
             write_msg(stdout, ("err", type(e).__name__, str(e), ""))
             continue
+        # Reserved wire kwarg (never reaches the worker fn): the driver's
+        # trace context — this request's span parents onto the pool
+        # dispatch span that shipped it (ISSUE 5 tentpole #1).
+        tctx = kwargs.pop("_blit_trace", None) if isinstance(kwargs, dict) else None
         try:
-            result = resolve(fn_path)(*args, **kwargs)
+            from blit.observability import tracer
+
+            tr = tracer()
+            with tr.activate(tctx), tr.span(
+                f"agent.{fn_path.rpartition('.')[2]}", fn=fn_path
+            ):
+                result = resolve(fn_path)(*args, **kwargs)
             write_msg(stdout, ("ok", result))
         except BaseException as e:  # noqa: BLE001 — everything crosses the wire
             write_msg(
@@ -244,6 +254,19 @@ def main() -> None:
     # repoint sys.stdout at stderr and keep the real fd for the protocol.
     proto_out = sys.stdout.buffer
     sys.stdout = io.TextIOWrapper(sys.stderr.buffer, line_buffering=True)
+    # Worker-startup logging (ISSUE 5 satellite): the pool stamps each
+    # agent's environment with its worker id, and BLIT_LOG_JSON flips the
+    # stderr records to machine-parseable JSON lines so a fleet's logs
+    # aggregate without re-parsing the human format.
+    try:
+        from blit.observability import configure_logging
+
+        configure_logging(
+            worker=int(os.environ.get("BLIT_WORKER_ID", "0") or 0),
+            json_lines=bool(os.environ.get("BLIT_LOG_JSON")),
+        )
+    except Exception:  # noqa: BLE001 — logging must not block serving
+        pass
     # Handshake: lets the client skip any ssh/rc banner noise ahead of us.
     proto_out.write(MAGIC)
     proto_out.flush()

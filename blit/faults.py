@@ -41,7 +41,9 @@ module holds both halves:
   ``Timeline.report(include_faults=True)`` (blit/observability.py) so a
   degraded run says so in its report.
 
-Imports nothing from the rest of blit — every layer can depend on it.
+Imports nothing from the rest of blit at module scope — every layer can
+depend on it (telemetry hooks import blit.observability lazily, inside the
+functions that use them).
 """
 
 from __future__ import annotations
@@ -71,9 +73,18 @@ _counters: Dict[str, int] = {}
 
 
 def incr(name: str, n: int = 1) -> None:
-    """Bump a process-wide failure/recovery counter (thread-safe)."""
+    """Bump a process-wide failure/recovery counter (thread-safe).  Every
+    bump also lands in the flight recorder's event ring (failure counters
+    ARE the incident trail, blit/observability.py) — lazily imported so
+    this module keeps its import-nothing-at-module-scope contract."""
     with _counters_lock:
         _counters[name] = _counters.get(name, 0) + n
+    try:
+        from blit.observability import flight_recorder
+
+        flight_recorder().event("fault", name, n=n)
+    except Exception:  # noqa: BLE001 — counters must never fail the caller
+        pass
 
 
 def counters() -> Dict[str, int]:
@@ -279,7 +290,19 @@ class RetryPolicy:
         return max(0.0, d)
 
     def backoff(self, attempt: int) -> None:
-        self.sleep(self.delay_s(attempt))
+        d = self.delay_s(attempt)
+        try:
+            # The backoff distribution is a first-class load signal
+            # (ISSUE 5 tentpole #2): a fleet whose retry.backoff_s p99
+            # saturates max_s is in a failure storm, whatever the mean
+            # says.  Lazy import keeps this module's no-blit-imports-at-
+            # module-scope contract.
+            from blit.observability import process_timeline
+
+            process_timeline().observe("retry.backoff_s", d)
+        except Exception:  # noqa: BLE001 — telemetry must not break retry
+            pass
+        self.sleep(d)
 
 
 # A missing/forbidden file is a caller bug, not NFS weather — never retried.

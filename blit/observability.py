@@ -1,23 +1,61 @@
-"""Observability: per-stage timing, throughput counters, profiler traces,
+"""Observability: spans, per-stage timing, latency histograms, fleet
+telemetry harvest, a crash/stall flight recorder, profiler traces, and
 structured per-host logging.
 
 SURVEY.md §5: the reference's only observability is three ``@warn`` sites
 plus the host name stamped into inventory rows.  blit keeps the host/worker
-stamping and adds what a GB/s-class pipeline needs: a stage-timing registry
-(cheap, always on), optional JAX profiler traces (TensorBoard/Perfetto),
-and log records that carry host/worker context.
+stamping and adds what a GB/s-class serving stack needs (ISSUE 5 tentpole):
+
+- a stage-timing registry (:class:`Timeline` — cheap, always on), now
+  **mergeable** across processes so a worker fan-out folds into one fleet
+  report (:meth:`Timeline.merge` / :func:`merge_fleet`);
+- **spans** (:class:`Span`/:class:`Tracer`): request-scoped traces whose
+  context propagates through the worker fan-out (pool dispatch, the agent
+  wire) so one driver run parents per-worker child spans, exportable as
+  Chrome-trace-event JSON (Perfetto-loadable, complementing the JAX
+  profiler traces of :func:`profile_trace`);
+- **histograms** (:class:`HistogramStats`): log-bucketed, bounded-memory,
+  mergeable latency distributions (p50/p90/p99 + exact max) — the load
+  signals averages hide;
+- a **flight recorder** (:class:`FlightRecorder`): a fixed-size ring of
+  recent span/stage/fault events per process, dumped to JSON when a stall
+  watchdog trips, a breaker opens, or an agent dies — rendered by
+  ``python -m blit trace-view``;
+- optional JAX profiler traces (TensorBoard/Perfetto) and log records that
+  carry host/worker context (now also as JSON lines for fleet ingestion).
 """
 
 from __future__ import annotations
 
 import contextlib
+import itertools
 import json
 import logging
+import math
+import os
 import socket
+import threading
 import time
-from collections import defaultdict
+from collections import defaultdict, deque
 from dataclasses import dataclass, field
-from typing import Dict, Iterable, Iterator, Optional
+from typing import Dict, Iterable, Iterator, List, Optional
+
+log = logging.getLogger("blit.observability")
+
+_HOSTNAME: Optional[str] = None
+
+
+def hostname() -> str:
+    """This process's host name (cached — span creation must stay cheap)."""
+    global _HOSTNAME
+    if _HOSTNAME is None:
+        _HOSTNAME = socket.gethostname()
+    return _HOSTNAME
+
+
+# Worker id stamped into spans/snapshots (0 = the driver process by the
+# pool's convention); set by configure_logging(worker=...) at worker startup.
+_WORKER = 0
 
 
 @dataclass
@@ -60,12 +98,122 @@ class GaugeStats:
         self.n += 1
 
 
+# Log-bucketed histogram geometry: bucket i covers (base*2^(i-1), base*2^i]
+# with base = 1 µs; 64 buckets span 1 µs .. ~584 000 years, so no latency a
+# process can observe falls off the top.
+_HIST_BASE = 1e-6
+_HIST_NBUCKETS = 64
+_LOG2 = math.log(2.0)
+
+
+class HistogramStats:
+    """Log-bucketed value distribution: bounded memory (64 counters),
+    mergeable across processes, quantiles good to one bucket (a factor of
+    2) — latency must be reported as a distribution, not an average
+    (ISSUE 5 tentpole #2).  Exact ``min``/``max``/``sum`` ride along so the
+    tail operators page on (``max``) is never a bucket estimate."""
+
+    __slots__ = ("counts", "n", "total", "vmin", "vmax")
+
+    def __init__(self):
+        self.counts = [0] * _HIST_NBUCKETS
+        self.n = 0
+        self.total = 0.0
+        self.vmin = 0.0
+        self.vmax = 0.0
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        if v <= _HIST_BASE:
+            i = 0
+        else:
+            i = min(_HIST_NBUCKETS - 1,
+                    int(math.ceil(math.log(v / _HIST_BASE) / _LOG2)))
+        self.counts[i] += 1
+        if self.n == 0:
+            self.vmin = self.vmax = v
+        else:
+            self.vmin = min(self.vmin, v)
+            self.vmax = max(self.vmax, v)
+        self.n += 1
+        self.total += v
+
+    def percentile(self, p: float) -> float:
+        """Quantile estimate (0.0 when empty): the midpoint of the bucket
+        the rank falls in, clamped to the observed [min, max] envelope so
+        the extremes are exact."""
+        if self.n == 0:
+            return 0.0
+        # Nearest-rank: the 0-based index of the p-th sample.
+        rank = min(self.n - 1, max(0, int(math.ceil(p * self.n)) - 1))
+        acc = 0
+        for i, c in enumerate(self.counts):
+            acc += c
+            if c and acc > rank:
+                lo = _HIST_BASE * 2.0 ** (i - 1) if i else 0.0
+                hi = _HIST_BASE * 2.0 ** i
+                return min(max((lo + hi) / 2.0, self.vmin), self.vmax)
+        return self.vmax
+
+    def merge(self, other: "HistogramStats") -> "HistogramStats":
+        """Fold ``other`` into self (commutative/associative: bucket counts
+        and totals sum, the envelope widens)."""
+        if other.n:
+            if self.n == 0:
+                self.vmin, self.vmax = other.vmin, other.vmax
+            else:
+                self.vmin = min(self.vmin, other.vmin)
+                self.vmax = max(self.vmax, other.vmax)
+        for i, c in enumerate(other.counts):
+            if c:
+                self.counts[i] += c
+        self.n += other.n
+        self.total += other.total
+        return self
+
+    def reset(self) -> None:
+        """Zero IN PLACE, preserving identity (the Timeline.reset rule)."""
+        for i in range(_HIST_NBUCKETS):
+            self.counts[i] = 0
+        self.n = 0
+        self.total = 0.0
+        self.vmin = self.vmax = 0.0
+
+    def report(self) -> Dict[str, float]:
+        mean = self.total / self.n if self.n else 0.0
+        return {"n": self.n, "mean": round(mean, 6),
+                "p50": round(self.percentile(0.50), 6),
+                "p90": round(self.percentile(0.90), 6),
+                "p99": round(self.percentile(0.99), 6),
+                "max": round(self.vmax, 6)}
+
+    def state(self) -> Dict:
+        """JSON-serializable raw state (the harvest wire format — reports
+        round, state doesn't, so fleet merges stay exact)."""
+        return {"counts": list(self.counts), "n": self.n,
+                "total": self.total, "vmin": self.vmin, "vmax": self.vmax}
+
+    @classmethod
+    def from_state(cls, st: Dict) -> "HistogramStats":
+        h = cls()
+        counts = list(st.get("counts", []))[:_HIST_NBUCKETS]
+        h.counts[: len(counts)] = [int(c) for c in counts]
+        h.n = int(st.get("n", 0))
+        h.total = float(st.get("total", 0.0))
+        h.vmin = float(st.get("vmin", 0.0))
+        h.vmax = float(st.get("vmax", 0.0))
+        return h
+
+
 @dataclass
 class Timeline:
     """A registry of named stage timings (one per pipeline/driver)."""
 
     stages: Dict[str, StageStats] = field(default_factory=lambda: defaultdict(StageStats))
     gauges: Dict[str, GaugeStats] = field(default_factory=lambda: defaultdict(GaugeStats))
+    hists: Dict[str, HistogramStats] = field(
+        default_factory=lambda: defaultdict(HistogramStats)
+    )
 
     @contextlib.contextmanager
     def stage(
@@ -75,12 +223,14 @@ class Timeline:
         try:
             yield
         finally:
+            dt = time.perf_counter() - t0
             s = self.stages[name]
             s.calls += 1
-            s.seconds += time.perf_counter() - t0
+            s.seconds += dt
             s.bytes += nbytes
             if byte_free:
                 s.byte_free = True
+            _FLIGHT.stage_event(name, dt, nbytes)
 
     def count(self, name: str, n: int = 1) -> None:
         """Record a byte-free event counter as a stage (``calls`` carries
@@ -90,6 +240,14 @@ class Timeline:
         s = self.stages[name]
         s.calls += n
         s.byte_free = True
+        _FLIGHT.event("count", name, n=n)
+
+    def observe(self, name: str, value: float) -> None:
+        """Record one sample into a log-bucketed latency/size histogram
+        (bounded memory; p50/p90/p99 + max in :meth:`report`) — chunk
+        latency, queue wait, readback lag and retry backoff live here
+        instead of on gauges, because their tails are the signal."""
+        self.hists[name].observe(value)
 
     def gauge(self, name: str, value: float) -> None:
         """Sample a level gauge (queue depth, per-job wait seconds — the
@@ -115,6 +273,8 @@ class Timeline:
         for g in list(self.gauges.values()):
             g.last = g.lo = g.hi = 0.0
             g.n = 0
+        for h in list(self.hists.values()):
+            h.reset()
 
     def overlap_efficiency(self, wall: str = "stream",
                            work: Iterable[str] = ("device", "readback",
@@ -156,6 +316,10 @@ class Timeline:
                     "hi": round(g.hi, 6), "n": g.n}
                 for k, g in sorted(list(self.gauges.items()))
             }
+        if self.hists:
+            out["hists"] = {
+                k: h.report() for k, h in sorted(list(self.hists.items()))
+            }
         if include_faults:
             # Process-wide failure/recovery totals (blit/faults.py):
             # retry.io / retry.remote / mask.antenna / breaker.trip /
@@ -187,6 +351,71 @@ class Timeline:
                           "bytes": v.bytes - b0}
         return out
 
+    def merge(self, other: "Timeline") -> "Timeline":
+        """Fold ``other`` into self — the fleet-harvest fold (ISSUE 5
+        tentpole #3).  Stage and histogram merges are commutative and
+        associative (sums / bucket sums), so a per-host fold and a flat
+        fleet fold give the same totals whatever order workers answered
+        in (tests/test_telemetry.py pins this).  Gauges keep the widened
+        [lo, hi] envelope and the sample count; ``last`` keeps self's
+        unless self never sampled (point samples from different processes
+        have no meaningful merged "last")."""
+        for k, s in list(other.stages.items()):
+            d = self.stages[k]
+            d.calls += s.calls
+            d.seconds += s.seconds
+            d.bytes += s.bytes
+            if s.byte_free:
+                d.byte_free = True
+        for k, g in list(other.gauges.items()):
+            d = self.gauges[k]
+            if g.n:
+                if d.n == 0:
+                    d.last, d.lo, d.hi = g.last, g.lo, g.hi
+                else:
+                    d.lo = min(d.lo, g.lo)
+                    d.hi = max(d.hi, g.hi)
+                d.n += g.n
+        for k, h in list(other.hists.items()):
+            self.hists[k].merge(h)
+        return self
+
+    def state(self) -> Dict:
+        """Full JSON-serializable raw state — the telemetry-harvest wire
+        format (:func:`telemetry_snapshot`).  Unlike :meth:`report` nothing
+        is rounded, so :meth:`from_state` + :meth:`merge` is exact."""
+        return {
+            "stages": {
+                k: {"calls": v.calls, "seconds": v.seconds,
+                    "bytes": v.bytes, "byte_free": v.byte_free}
+                for k, v in list(self.stages.items())
+            },
+            "gauges": {
+                k: {"last": g.last, "lo": g.lo, "hi": g.hi, "n": g.n}
+                for k, g in list(self.gauges.items())
+            },
+            "hists": {k: h.state() for k, h in list(self.hists.items())},
+        }
+
+    @classmethod
+    def from_state(cls, st: Dict) -> "Timeline":
+        tl = cls()
+        for k, v in (st.get("stages") or {}).items():
+            s = tl.stages[k]
+            s.calls = int(v.get("calls", 0))
+            s.seconds = float(v.get("seconds", 0.0))
+            s.bytes = int(v.get("bytes", 0))
+            s.byte_free = bool(v.get("byte_free", False))
+        for k, v in (st.get("gauges") or {}).items():
+            g = tl.gauges[k]
+            g.last = float(v.get("last", 0.0))
+            g.lo = float(v.get("lo", 0.0))
+            g.hi = float(v.get("hi", 0.0))
+            g.n = int(v.get("n", 0))
+        for k, v in (st.get("hists") or {}).items():
+            tl.hists[k] = HistogramStats.from_state(v)
+        return tl
+
     def log(self, logger: Optional[logging.Logger] = None) -> None:
         (logger or logging.getLogger("blit.timeline")).info(
             "timeline %s", json.dumps(self.report())
@@ -206,6 +435,559 @@ def profile_trace(logdir: Optional[str]) -> Iterator[None]:
         yield
 
 
+# -- spans ------------------------------------------------------------------
+
+_id_counter = itertools.count(1)
+# Per-process id prefix: spans harvested from N worker processes must not
+# collide in the merged trace.  pid alone recycles; add 2 random bytes.
+_ID_PREFIX = f"{os.getpid():x}{os.urandom(2).hex()}"
+_ID_PID = os.getpid()
+
+
+def _new_id() -> str:
+    global _ID_PREFIX, _ID_PID
+    pid = os.getpid()
+    if pid != _ID_PID:
+        # Forked child (the process pool backend forks on Linux): the
+        # inherited prefix AND counter position would collide span ids
+        # across every sibling worker — re-key the prefix per process.
+        _ID_PREFIX = f"{pid:x}{os.urandom(2).hex()}"
+        _ID_PID = pid
+    return f"{_ID_PREFIX}.{next(_id_counter):x}"
+
+
+class Span:
+    """One finished traced operation: name, wall start (epoch seconds),
+    duration, host/worker/thread identity, trace linkage (trace id, span
+    id, parent span id) and small free-form attrs.  Cheap by design —
+    created on context-manager entry, recorded on exit."""
+
+    __slots__ = ("name", "t0", "duration_s", "trace_id", "span_id",
+                 "parent_id", "host", "worker", "tid", "attrs")
+
+    def __init__(self, name: str, trace_id: str, span_id: str,
+                 parent_id: Optional[str], attrs: Optional[Dict]):
+        self.name = name
+        self.t0 = time.time()
+        self.duration_s = 0.0
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.host = hostname()
+        self.worker = _WORKER
+        self.tid = threading.get_ident() & 0x7FFFFFFF
+        self.attrs = attrs
+
+    def as_dict(self) -> Dict:
+        d = {"name": self.name, "t0": self.t0,
+             "duration_s": self.duration_s, "trace": self.trace_id,
+             "span": self.span_id, "parent": self.parent_id,
+             "host": self.host, "worker": self.worker, "tid": self.tid}
+        if self.attrs:
+            d["attrs"] = self.attrs
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "Span":
+        sp = cls(d.get("name", "?"), d.get("trace", ""), d.get("span", ""),
+                 d.get("parent"), d.get("attrs") or None)
+        sp.t0 = float(d.get("t0", 0.0))
+        sp.duration_s = float(d.get("duration_s", 0.0))
+        sp.host = d.get("host", sp.host)
+        sp.worker = int(d.get("worker", 0))
+        sp.tid = int(d.get("tid", 0))
+        return sp
+
+
+class Tracer:
+    """Always-on, cheap span recorder with ambient (thread-local) trace
+    context.
+
+    A :meth:`span` opened with no ambient context starts a new trace; one
+    opened inside another span (same thread) or under :meth:`activate`
+    (an adopted cross-thread/cross-process context) becomes its child.
+    :meth:`context` exports the current ``{"trace", "span"}`` pair — the
+    pool dispatch ships it to workers so their spans parent onto the
+    driver's (ISSUE 5 tentpole #1).  Finished spans land in a bounded
+    deque (oldest dropped) and in the process flight recorder.
+
+    ``enabled=False`` (or ``BLIT_SPANS=0`` in the environment) turns
+    :meth:`span` into a near-free no-op — the ingest-bench A/B lever for
+    the ≤1 % overhead acceptance bound."""
+
+    def __init__(self, max_spans: int = 16384, enabled: Optional[bool] = None):
+        if enabled is None:
+            enabled = os.environ.get("BLIT_SPANS", "1").lower() not in (
+                "0", "false", "off", "")
+        self.enabled = enabled
+        self._spans: deque = deque(maxlen=max_spans)
+        self._tls = threading.local()
+
+    def _stack(self) -> List:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs) -> Iterator[Optional[Span]]:
+        """Time a traced operation.  Yields the live :class:`Span` (or
+        ``None`` when tracing is disabled); extra keyword args become
+        span attrs."""
+        if not self.enabled:
+            yield None
+            return
+        stack = self._stack()
+        if stack:
+            trace_id, parent_id = stack[-1]
+        else:
+            trace_id, parent_id = _new_id(), None
+        sp = Span(name, trace_id, _new_id(), parent_id, attrs or None)
+        stack.append((trace_id, sp.span_id))
+        p0 = time.perf_counter()
+        try:
+            yield sp
+        finally:
+            sp.duration_s = time.perf_counter() - p0
+            stack.pop()
+            self._spans.append(sp)
+            _FLIGHT.span_event(sp)
+
+    @contextlib.contextmanager
+    def activate(self, ctx: Optional[Dict]) -> Iterator[None]:
+        """Adopt a ``{"trace", "span"}`` context exported by
+        :meth:`context` in another thread or process: spans opened inside
+        become children of that remote span."""
+        if not ctx or not self.enabled:
+            yield
+            return
+        stack = self._stack()
+        stack.append((str(ctx.get("trace", "")), str(ctx.get("span", ""))))
+        try:
+            yield
+        finally:
+            stack.pop()
+
+    def context(self) -> Optional[Dict]:
+        """The ambient ``{"trace", "span"}`` pair (None outside any span
+        or with tracing disabled) — ship it across the fan-out."""
+        if not self.enabled:
+            return None
+        stack = getattr(self._tls, "stack", None)
+        if not stack:
+            return None
+        trace_id, span_id = stack[-1]
+        return {"trace": trace_id, "span": span_id}
+
+    def spans(self) -> List[Span]:
+        return list(self._spans)
+
+    def span_dicts(self) -> List[Dict]:
+        return [s.as_dict() for s in self._spans]
+
+    def ingest(self, span_dicts: Iterable[Dict]) -> None:
+        """Adopt foreign spans (a fleet harvest) into this tracer so one
+        :meth:`export_chrome` covers driver and workers."""
+        for d in span_dicts:
+            try:
+                self._spans.append(Span.from_dict(d))
+            except (TypeError, ValueError):  # malformed harvest entry
+                continue
+
+    def reset(self) -> None:
+        self._spans.clear()
+
+    def export_chrome(self, path: Optional[str] = None,
+                      extra: Optional[Iterable[Dict]] = None):
+        """Render the recorded spans as Chrome trace events (Perfetto /
+        ``chrome://tracing`` loadable).  ``extra`` takes harvested span
+        dicts to merge in.  Returns the event document; writes JSON to
+        ``path`` when given and returns the path instead."""
+        spans = self.spans()
+        if extra:
+            spans = spans + [Span.from_dict(d) for d in extra]
+        # Dedupe by span id: with the in-process pool backends a harvest
+        # returns the driver's own spans, so recorded + ``extra`` overlap.
+        seen, unique = set(), []
+        for sp in spans:
+            if sp.span_id in seen:
+                continue
+            seen.add(sp.span_id)
+            unique.append(sp)
+        spans = unique
+        # Stable pid per (host, worker) so each worker renders as its own
+        # process track, named.
+        pids: Dict = {}
+        events: List[Dict] = []
+        for sp in spans:
+            key = (sp.host, sp.worker)
+            pid = pids.get(key)
+            if pid is None:
+                pid = pids[key] = len(pids) + 1
+                events.append({"ph": "M", "pid": pid, "tid": 0,
+                               "name": "process_name",
+                               "args": {"name": f"{sp.host}/w{sp.worker}"}})
+            ev = {"name": sp.name, "cat": "blit", "ph": "X",
+                  "ts": sp.t0 * 1e6, "dur": max(sp.duration_s, 1e-7) * 1e6,
+                  "pid": pid, "tid": sp.tid,
+                  "args": {"trace": sp.trace_id, "span": sp.span_id,
+                           "parent": sp.parent_id}}
+            if sp.attrs:
+                ev["args"].update(sp.attrs)
+            events.append(ev)
+        doc = {"traceEvents": events, "displayTimeUnit": "ms"}
+        if path is None:
+            return doc
+        with open(path, "w") as f:
+            json.dump(doc, f)
+        return path
+
+
+_TRACER = Tracer()
+
+
+def tracer() -> Tracer:
+    """The process-wide tracer (workers harvest it; drivers export it)."""
+    return _TRACER
+
+
+def span(name: str, **attrs):
+    """Module-level convenience: ``with observability.span("leg"): ...``"""
+    return _TRACER.span(name, **attrs)
+
+
+# -- flight recorder --------------------------------------------------------
+
+
+class FlightRecorder:
+    """A fixed-size ring of recent span/stage/fault events, dumped to JSON
+    when something trips (ISSUE 5 tentpole #4): a rotation stall watchdog,
+    an opened circuit breaker, a dead agent.  Recording must be cheap
+    enough to leave on (bounded deque appends, no locks — CPython deque
+    appends are atomic); dumping is rate-limited so a retry storm writes
+    one incident file, not hundreds.  ``python -m blit trace-view``
+    renders a dump into an incident summary."""
+
+    def __init__(self, capacity: int = 512, min_interval_s: float = 60.0):
+        self._ring: deque = deque(maxlen=capacity)
+        self.min_interval_s = min_interval_s
+        self._last_dump = float("-inf")
+        self._dump_lock = threading.Lock()
+
+    # -- recording (hot paths) --------------------------------------------
+    def event(self, kind: str, name: str, **fields) -> None:
+        e = {"t": time.time(), "kind": kind, "name": name}
+        if fields:
+            e.update(fields)
+        self._ring.append(e)
+
+    def span_event(self, sp: Span) -> None:
+        self._ring.append({"t": sp.t0, "kind": "span", "name": sp.name,
+                           "dur_s": round(sp.duration_s, 6),
+                           "span": sp.span_id, "parent": sp.parent_id})
+
+    def stage_event(self, name: str, seconds: float, nbytes: int) -> None:
+        self._ring.append({"t": time.time(), "kind": "stage", "name": name,
+                           "s": round(seconds, 6), "bytes": nbytes})
+
+    def events(self) -> List[Dict]:
+        return list(self._ring)
+
+    def clear(self) -> None:
+        self._ring.clear()
+
+    # -- dumping (incident path) ------------------------------------------
+    def dump(self, reason: str, path: Optional[str] = None,
+             force: bool = False) -> Optional[str]:
+        """Write the incident JSON (ring + fault counters + process
+        timeline + recent spans) and return its path.  Never raises (the
+        caller is already mid-incident); returns None when rate-limited
+        (``force=True`` overrides) or when ``BLIT_FLIGHT_DISABLE`` is
+        set."""
+        if os.environ.get("BLIT_FLIGHT_DISABLE"):
+            return None
+        try:
+            now = time.monotonic()
+            with self._dump_lock:
+                if not force and now - self._last_dump < self.min_interval_s:
+                    return None
+                self._last_dump = now
+            from blit import faults
+
+            doc = {
+                "reason": reason,
+                "t": time.time(),
+                "host": hostname(),
+                "pid": os.getpid(),
+                "worker": _WORKER,
+                "events": self.events(),
+                "faults": faults.counters(),
+                "timeline": process_timeline().report(),
+                "spans": [s.as_dict() for s in _TRACER.spans()[-64:]],
+            }
+            if path is None:
+                d = os.environ.get("BLIT_FLIGHT_DIR")
+                if not d:
+                    import tempfile
+
+                    d = tempfile.gettempdir()
+                path = os.path.join(
+                    d, f"blit-flight-{hostname()}-{os.getpid()}-"
+                       f"{int(doc['t'])}.json")
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(doc, f)
+            os.replace(tmp, path)
+            log.error("flight recorder dumped to %s (%s)", path, reason)
+            return path
+        except Exception:  # noqa: BLE001 — never mask the real incident
+            log.warning("flight recorder dump failed", exc_info=True)
+            return None
+
+
+_FLIGHT = FlightRecorder()
+
+
+def flight_recorder() -> FlightRecorder:
+    """The process-wide flight recorder."""
+    return _FLIGHT
+
+
+def render_flight_dump(doc: Dict, tail: int = 40) -> str:
+    """A flight-recorder dump as a readable incident summary (the
+    ``python -m blit trace-view`` body): what tripped, where, the fault
+    counters, and the last events before the trip."""
+    lines = []
+    t = doc.get("t", 0.0)
+    when = time.strftime("%Y-%m-%d %H:%M:%S", time.gmtime(t)) if t else "?"
+    lines.append("=== blit flight record ===")
+    lines.append(f"reason : {doc.get('reason', '?')}")
+    lines.append(f"where  : {doc.get('host', '?')}/w{doc.get('worker', 0)} "
+                 f"pid {doc.get('pid', '?')}")
+    lines.append(f"when   : {when} UTC")
+    faults_c = doc.get("faults") or {}
+    if faults_c:
+        lines.append("fault counters:")
+        for k, v in sorted(faults_c.items()):
+            lines.append(f"  {k:<32} {v}")
+    tl = doc.get("timeline") or {}
+    stages = {k: v for k, v in tl.items()
+              if isinstance(v, dict) and "calls" in v}
+    if stages:
+        lines.append("process timeline (stages):")
+        for k, v in sorted(stages.items()):
+            lines.append(
+                f"  {k:<20} calls={v.get('calls', 0):<8} "
+                f"s={v.get('seconds', 0.0):<12} bytes={v.get('bytes', 0)}")
+    events = doc.get("events") or []
+    lines.append(f"last {min(tail, len(events))} of {len(events)} recorded "
+                 "events (oldest first):")
+    for e in events[-tail:]:
+        ts = time.strftime("%H:%M:%S", time.gmtime(e.get("t", 0.0)))
+        kind = e.get("kind", "?")
+        name = e.get("name", "?")
+        rest = {k: v for k, v in e.items()
+                if k not in ("t", "kind", "name")}
+        detail = " ".join(f"{k}={v}" for k, v in rest.items())
+        lines.append(f"  {ts} [{kind:<5}] {name} {detail}".rstrip())
+    return "\n".join(lines)
+
+
+# -- process telemetry / fleet harvest --------------------------------------
+
+_PROCESS_TL = Timeline()
+
+
+def process_timeline() -> Timeline:
+    """The process-wide ambient :class:`Timeline` — what worker-side entry
+    points (``blit.workers.reduce_raw``, retry backoff, ...) record on so
+    :func:`telemetry_snapshot` has one table to ship when the driver
+    harvests the fleet."""
+    return _PROCESS_TL
+
+
+def telemetry_snapshot(reset: bool = False, spans: bool = True) -> Dict:
+    """This process's telemetry, JSON/pickle-safe (plain builtins only —
+    it crosses the agent wire): host/pid/worker identity, the process
+    timeline's raw state, the fault counters, and the finished spans.
+    The harvest endpoint ``WorkerPool.harvest_telemetry`` broadcasts.
+
+    ``reset=True`` zeroes the process timeline (identity-preserving) and
+    drains the span buffer after snapshotting — interval-scrape mode."""
+    from blit import faults
+
+    out = {
+        "host": hostname(),
+        "pid": os.getpid(),
+        "worker": _WORKER,
+        "timeline": _PROCESS_TL.state(),
+        "faults": faults.counters(),
+        "spans": _TRACER.span_dicts() if spans else [],
+    }
+    if reset:
+        _PROCESS_TL.reset()
+        _TRACER.reset()
+    return out
+
+
+def merge_fleet(snapshots: Iterable[Optional[Dict]],
+                errors: Optional[Dict[str, str]] = None) -> Dict:
+    """Fold :func:`telemetry_snapshot` results into ONE per-host-keyed
+    fleet report (ISSUE 5 tentpole #3): every host gets its merged stage
+    table and fault counters, and the ``fleet`` entry is the whole-run
+    fold.  Snapshots from the same (host, pid) are counted once — with
+    the thread/local backends every "worker" answers from the driver
+    process, and double-merging would inflate every counter."""
+    hosts: Dict[str, Dict] = {}
+    fleet = Timeline()
+    fleet_faults: Dict[str, int] = {}
+    spans: List[Dict] = []
+    # One snapshot per (host, pid), keeping the RICHEST: with the
+    # thread/local backends every "worker" answers from one process, and
+    # under reset=True whichever call ran first drained the telemetry —
+    # the later calls return empty snapshots that must not shadow the
+    # populated one (first-wins would nondeterministically drop the run).
+    best: Dict = {}
+    for snap in snapshots:
+        if not isinstance(snap, dict) or "host" not in snap:
+            continue
+        key = (snap["host"], snap.get("pid"))
+        richness = (len((snap.get("timeline") or {}).get("stages") or {})
+                    + len(snap.get("spans") or []))
+        if key not in best or richness > best[key][0]:
+            best[key] = (richness, snap)
+    for _, snap in best.values():
+        entry = hosts.setdefault(
+            snap["host"], {"workers": [], "tl": Timeline(), "faults": {}})
+        entry["workers"].append(
+            {"pid": snap.get("pid"), "worker": snap.get("worker", 0)})
+        tl = Timeline.from_state(snap.get("timeline") or {})
+        entry["tl"].merge(tl)
+        fleet.merge(tl)
+        for k, v in (snap.get("faults") or {}).items():
+            entry["faults"][k] = entry["faults"].get(k, 0) + v
+            fleet_faults[k] = fleet_faults.get(k, 0) + v
+        spans.extend(snap.get("spans") or [])
+    report = {
+        "hosts": {
+            h: {"workers": e["workers"], "stages": e["tl"].report(),
+                "faults": e["faults"]}
+            for h, e in sorted(hosts.items())
+        },
+        "fleet": fleet.report(),
+        "faults": fleet_faults,
+        "spans": spans,
+    }
+    if errors:
+        report["errors"] = dict(errors)
+    return report
+
+
+def local_fleet_report() -> Dict:
+    """The degenerate single-process fleet report (driver only) — what a
+    run with no pool, or the tier-1 CI job, publishes."""
+    return merge_fleet([telemetry_snapshot()])
+
+
+def maybe_write_report(path: Optional[str] = None) -> Optional[str]:
+    """Write :func:`local_fleet_report` JSON to ``path`` (default: the
+    ``BLIT_TELEMETRY_OUT`` environment variable; no-op when unset).  The
+    CI artifact hook — never raises."""
+    path = path or os.environ.get("BLIT_TELEMETRY_OUT")
+    if not path:
+        return None
+    try:
+        with open(path, "w") as f:
+            json.dump(local_fleet_report(), f)
+        return path
+    except Exception:  # noqa: BLE001 — reporting must not fail the run
+        log.warning("telemetry report write to %s failed", path,
+                    exc_info=True)
+        return None
+
+
+def render_prometheus(report: Dict) -> str:
+    """A fleet report (:func:`merge_fleet`) in Prometheus exposition
+    format — one scrape body with host-labelled stage/gauge/histogram/
+    fault series (the ``python -m blit telemetry --format prom`` output)."""
+    lines: List[str] = []
+
+    def head(metric: str, mtype: str, help_: str) -> None:
+        lines.append(f"# HELP {metric} {help_}")
+        lines.append(f"# TYPE {metric} {mtype}")
+
+    head("blit_stage_seconds_total", "counter",
+         "Accumulated wall seconds per pipeline stage")
+    head("blit_stage_calls_total", "counter", "Stage invocations")
+    head("blit_stage_bytes_total", "counter", "Bytes moved per stage")
+    head("blit_gauge", "gauge", "Last sampled level")
+    head("blit_latency_seconds", "summary",
+         "Log-bucketed latency distribution quantiles")
+    head("blit_fault_total", "counter", "Failure/recovery counters")
+    for host, e in (report.get("hosts") or {}).items():
+        stages = e.get("stages") or {}
+        for k, row in stages.items():
+            if k in ("gauges", "hists", "faults") or not isinstance(row, dict):
+                continue
+            lab = f'{{host="{host}",stage="{k}"}}'
+            lines.append(f"blit_stage_seconds_total{lab} {row.get('seconds', 0)}")
+            lines.append(f"blit_stage_calls_total{lab} {row.get('calls', 0)}")
+            lines.append(f"blit_stage_bytes_total{lab} {row.get('bytes', 0)}")
+        for k, g in (stages.get("gauges") or {}).items():
+            lines.append(
+                f'blit_gauge{{host="{host}",name="{k}"}} {g.get("last", 0)}')
+        for k, h in (stages.get("hists") or {}).items():
+            for q, key in (("0.5", "p50"), ("0.9", "p90"), ("0.99", "p99")):
+                lines.append(
+                    f'blit_latency_seconds{{host="{host}",name="{k}",'
+                    f'quantile="{q}"}} {h.get(key, 0)}')
+            lines.append(
+                f'blit_latency_seconds_count{{host="{host}",name="{k}"}} '
+                f'{h.get("n", 0)}')
+        for k, v in (e.get("faults") or {}).items():
+            lines.append(
+                f'blit_fault_total{{host="{host}",counter="{k}"}} {v}')
+    return "\n".join(lines) + "\n"
+
+
+def render_fleet_text(report: Dict) -> str:
+    """A fleet report as a human-readable per-host summary (the default
+    ``python -m blit telemetry`` output)."""
+    lines: List[str] = []
+    for host, e in (report.get("hosts") or {}).items():
+        workers = e.get("workers") or []
+        lines.append(f"host {host} ({len(workers)} worker"
+                     f"{'s' if len(workers) != 1 else ''})")
+        stages = e.get("stages") or {}
+        rows = [(k, v) for k, v in stages.items()
+                if isinstance(v, dict) and "calls" in v]
+        if rows:
+            lines.append(f"  {'stage':<22} {'calls':>8} {'seconds':>12} "
+                         f"{'bytes':>16} {'GB/s':>8}")
+            for k, v in sorted(rows):
+                lines.append(
+                    f"  {k:<22} {v.get('calls', 0):>8} "
+                    f"{v.get('seconds', 0.0):>12} {v.get('bytes', 0):>16} "
+                    f"{v.get('gbps', 0.0):>8}")
+        for k, h in sorted((stages.get("hists") or {}).items()):
+            lines.append(
+                f"  hist {k:<18} n={h.get('n', 0):<7} "
+                f"p50={h.get('p50', 0)} p99={h.get('p99', 0)} "
+                f"max={h.get('max', 0)}")
+        for k, v in sorted((e.get("faults") or {}).items()):
+            lines.append(f"  fault {k:<20} {v}")
+    errs = report.get("errors") or {}
+    for host, msg in sorted(errs.items()):
+        lines.append(f"host {host}: HARVEST FAILED — {msg}")
+    fleet = report.get("fleet") or {}
+    nstages = sum(1 for v in fleet.values()
+                  if isinstance(v, dict) and "calls" in v)
+    lines.append(f"fleet: {len(report.get('hosts') or {})} hosts, "
+                 f"{nstages} stages, "
+                 f"{len(report.get('spans') or [])} spans")
+    return "\n".join(lines)
+
+
 class HostContextFilter(logging.Filter):
     """Injects ``host`` and ``worker`` fields into every record so the
     fan-out logs stay attributable (the reference stamps host into every
@@ -222,22 +1004,53 @@ class HostContextFilter(logging.Filter):
         return True
 
 
-def configure_logging(level: int = logging.INFO, worker: int = 0) -> None:
+class JsonLineFormatter(logging.Formatter):
+    """One JSON object per record (ts/level/host/worker/name/msg) so fleet
+    logs are machine-parseable (ISSUE 5 satellite) — a harvest pipeline
+    must never re-parse the human format's free text."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        doc = {
+            "ts": round(record.created, 3),
+            "level": record.levelname,
+            "host": getattr(record, "host", hostname()),
+            "worker": getattr(record, "worker", 0),
+            "name": record.name,
+            "msg": record.getMessage(),
+        }
+        if record.exc_info:
+            doc["exc"] = self.formatException(record.exc_info)
+        return json.dumps(doc)
+
+
+def configure_logging(level: int = logging.INFO, worker: int = 0,
+                      json_lines: bool = False, stream=None) -> None:
     """Structured stderr logging with host/worker context for every blit
     logger.  Idempotent: re-calling replaces the previous blit handler (a
-    worker re-configuring with its id must not duplicate output)."""
+    worker re-configuring with its id must not duplicate output).
+
+    ``json_lines=True`` emits one JSON object per record
+    (:class:`JsonLineFormatter`) instead of the human format — worker
+    startup threads it via ``BLIT_LOG_JSON`` in the agent environment
+    (:mod:`blit.agent`).  ``stream`` overrides the handler target
+    (tests capture it); default stderr."""
+    global _WORKER
+    _WORKER = worker  # stamp spans/snapshots with the same identity
     root = logging.getLogger("blit")
     for h in list(root.handlers):
         if getattr(h, "_blit_handler", False):
             root.removeHandler(h)
-    handler = logging.StreamHandler()
+    handler = logging.StreamHandler(stream)
     handler._blit_handler = True
     handler.addFilter(HostContextFilter(worker))
-    handler.setFormatter(
-        logging.Formatter(
-            "%(asctime)s %(levelname)s %(host)s/w%(worker)d %(name)s: %(message)s"
+    if json_lines:
+        handler.setFormatter(JsonLineFormatter())
+    else:
+        handler.setFormatter(
+            logging.Formatter(
+                "%(asctime)s %(levelname)s %(host)s/w%(worker)d %(name)s: %(message)s"
+            )
         )
-    )
     root.setLevel(level)
     root.addHandler(handler)
     # Our handler owns blit output; don't duplicate through root handlers.

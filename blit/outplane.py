@@ -66,7 +66,7 @@ from typing import Callable, Iterator, List, Optional
 
 import numpy as np
 
-from blit import faults
+from blit import faults, observability
 from blit.observability import Timeline
 
 log = logging.getLogger("blit.outplane")
@@ -139,6 +139,11 @@ class OutputRotation:
         self._free: List[np.ndarray] = []  # released ring slabs (reuse)
         self._nslabs = 0
         self._beat = time.monotonic()
+        # Captured at construction (the consumer's thread): the readback
+        # thread's lifetime span parents onto whatever driver span built
+        # the rotation, keeping the output plane causally linked in a
+        # trace (ISSUE 5 tentpole #1).
+        self._span_ctx = observability.tracer().context()
         self._thread = threading.Thread(
             target=self._run, name=name, daemon=True
         )
@@ -146,6 +151,11 @@ class OutputRotation:
 
     # -- readback thread ---------------------------------------------------
     def _run(self) -> None:
+        tr = observability.tracer()
+        with tr.activate(self._span_ctx), tr.span("outplane.readback"):
+            self._run_inner()
+
+    def _run_inner(self) -> None:
         import jax
 
         try:
@@ -161,7 +171,12 @@ class OutputRotation:
                         self._eof = True
                         self._cv.notify_all()
                     return
-                out, nbytes, payload, on_consumed = item
+                out, nbytes, payload, on_consumed, t_enq = item
+                t_got = time.perf_counter()
+                # Queue-side lag distribution (ISSUE 5 tentpole #2): how
+                # long dispatches wait before the readback thread reaches
+                # them — the leading indicator of a saturating D2H link.
+                self._tl.observe("out.readback_lag_s", t_got - t_enq)
                 self._beat = time.monotonic()
                 # The wait on the dispatch IS the device stage: overlapped
                 # with the consumer thread's next dispatch and the ingest
@@ -205,6 +220,11 @@ class OutputRotation:
                     (lambda s=host: self._release_slab(s))
                     if recycled else None
                 )
+                # Per-chunk service latency (sync wait + host fetch) —
+                # the distribution behind the aggregate device/readback
+                # stage seconds.
+                self._tl.observe("out.chunk_latency_s",
+                                 time.perf_counter() - t_got)
                 with self._cv:
                     self._pending -= 1
                     self._done.append(OutputSlab(host, payload, release))
@@ -264,11 +284,13 @@ class OutputRotation:
             and self._pending > 0
             and time.monotonic() - self._beat > self.stall_timeout_s
         ):
-            raise RuntimeError(
+            msg = (
                 f"{self._thread.name}: readback stalled — no progress for "
                 f"> {self.stall_timeout_s}s (stall watchdog; a wedged "
                 "device fetch would otherwise hang the stream)"
             )
+            observability.flight_recorder().dump(msg)
+            raise RuntimeError(msg)
 
     def put(self, out, *, nbytes: Optional[int] = None, payload=None,
             on_consumed: Optional[Callable[[], None]] = None
@@ -280,7 +302,8 @@ class OutputRotation:
         with self._cv:
             self._check()
             self._pending += 1
-        self._in.put((out, nbytes, payload, on_consumed))
+        self._in.put((out, nbytes, payload, on_consumed,
+                      time.perf_counter()))
         ready: List[OutputSlab] = []
         with self._cv:
             while True:
@@ -387,6 +410,7 @@ class AsyncSink:
         self._stopped = False
         self._stop_ev = threading.Event()
         self._beat = time.monotonic()
+        self._span_ctx = observability.tracer().context()
         self._thread = threading.Thread(
             target=self._run, name=name, daemon=True
         )
@@ -394,6 +418,13 @@ class AsyncSink:
 
     # -- writer thread -----------------------------------------------------
     def _run(self) -> None:
+        tr = observability.tracer()
+        with tr.activate(self._span_ctx), tr.span(
+            "outplane.sink", path=str(self._key or "")
+        ):
+            self._run_inner()
+
+    def _run_inner(self) -> None:
         while True:
             try:
                 item = self._q.get(timeout=0.2)
@@ -457,11 +488,13 @@ class AsyncSink:
                     and self._thread.is_alive()
                     and time.monotonic() - self._beat > self.stall_timeout_s
                 ):
-                    raise RuntimeError(
+                    msg = (
                         f"{self._thread.name}: writer stalled — no progress "
                         f"for > {self.stall_timeout_s}s (stall watchdog; a "
                         "wedged disk append would otherwise hang the plane)"
                     )
+                    observability.flight_recorder().dump(msg)
+                    raise RuntimeError(msg)
 
     def append(self, slab: np.ndarray,
                release: Optional[Callable[[], None]] = None) -> None:
@@ -489,10 +522,12 @@ class AsyncSink:
                 and self._thread.is_alive()
                 and time.monotonic() - self._beat > self.stall_timeout_s
             ):
-                raise RuntimeError(
+                msg = (
                     f"{self._thread.name}: writer stalled inside flush "
                     f"barrier (> {self.stall_timeout_s}s without progress)"
                 )
+                observability.flight_recorder().dump(msg)
+                raise RuntimeError(msg)
             if not self._thread.is_alive():
                 break  # died without recording? _check below decides
         self._check()

@@ -42,12 +42,27 @@ from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from blit import faults
+from blit import faults, observability
 from blit.io.guppi import open_raw
 from blit.observability import Timeline
 from blit.parallel.scan import _gapless, _gather_int64, _kept_samples
 
 log = logging.getLogger("blit.antenna")
+
+
+def _traced_fill(fill, name: str):
+    """Wrap a BufferRotation fill callback so the producer thread's whole
+    run records as one span, parented on the driver span that started the
+    feed (the fill runs on the rotation's thread, where the driver's
+    thread-local trace context would otherwise be invisible)."""
+    ctx = observability.tracer().context()
+
+    def run(rot):
+        tr = observability.tracer()
+        with tr.activate(ctx), tr.span(name):
+            fill(rot)
+
+    return run
 
 Planar = Tuple["object", "object"]
 
@@ -629,7 +644,8 @@ class AntennaStream(_DegradedContinuation):
 
         tl = self.timeline
         rot = BufferRotation(
-            self.prefetch_depth, self._fill, name="blit-antenna-feed",
+            self.prefetch_depth, _traced_fill(self._fill, "antenna.produce"),
+            name="blit-antenna-feed",
             stall_timeout_s=self.stall_timeout_s,
         )
         try:
@@ -877,7 +893,9 @@ class CorrelatorStream(_DegradedContinuation):
 
         tl = self.timeline
         rot = BufferRotation(
-            self.prefetch_depth, self._fill, name="blit-correlator-feed",
+            self.prefetch_depth,
+            _traced_fill(self._fill, "correlator.produce"),
+            name="blit-correlator-feed",
             stall_timeout_s=self.stall_timeout_s,
         )
         try:

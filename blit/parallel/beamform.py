@@ -340,28 +340,32 @@ def beamform_stream(
     rot = OutputRotation(depth=2, timeline=tl, reuse=False,
                          name="blit-bf-readback",
                          stall_timeout_s=stall_timeout_s)
+    from blit import observability
+
     try:
-        for win in feed:
-            if win.ntime % nint:
-                raise ValueError(
-                    f"window {win.index} holds {win.ntime} samples — not a "
-                    f"whole number of nint={nint} integrations; choose "
-                    "window_samples (and span) divisible by nint"
-                )
-            if win.masked:
-                # Degraded continuation (feed masked a failed antenna): the
-                # accumulated powers carry its zero weight; flag it in the
-                # driver's per-window stage tables too.
-                tl.count("masked_antennas", len(win.masked))
-            with tl.stage("dispatch", byte_free=True):
-                out = beamform(
-                    win.arrays, weights, mesh=mesh, axis=axis, nint=nint,
-                    detect=True, layout=layout,
-                )
-            for slab in rot.put(out, on_consumed=win.release):
+        with observability.span("beamform.stream"):
+            for win in feed:
+                if win.ntime % nint:
+                    raise ValueError(
+                        f"window {win.index} holds {win.ntime} samples — not a "
+                        f"whole number of nint={nint} integrations; choose "
+                        "window_samples (and span) divisible by nint"
+                    )
+                if win.masked:
+                    # Degraded continuation (feed masked a failed antenna): the
+                    # accumulated powers carry its zero weight; flag it in the
+                    # driver's per-window stage tables too.
+                    tl.count("masked_antennas", len(win.masked))
+                with observability.span("beamform.window", i=win.index), \
+                        tl.stage("dispatch", byte_free=True):
+                    out = beamform(
+                        win.arrays, weights, mesh=mesh, axis=axis, nint=nint,
+                        detect=True, layout=layout,
+                    )
+                for slab in rot.put(out, on_consumed=win.release):
+                    yield slab.data
+            for slab in rot.drain():
                 yield slab.data
-        for slab in rot.drain():
-            yield slab.data
     finally:
         rot.close()
 
@@ -385,6 +389,7 @@ def beamform_accumulate(
     any length."""
     import jax as _jax
 
+    from blit import observability
     from blit.observability import Timeline
     from blit.outplane import FoldInFlight
 
@@ -392,27 +397,29 @@ def beamform_accumulate(
     acc = None
     flight = FoldInFlight(tl, depth=1)
     add = _jax.jit(lambda a, p: a + p, donate_argnums=0)
-    for win in feed:
-        if win.masked:
-            tl.count("masked_antennas", len(win.masked))
-        # Lag-1 (shared FoldInFlight core, ISSUE 4): wait for the previous
-        # window's fold (its power output implies its input was consumed)
-        # and recycle its slot BEFORE dispatching the next fold.
-        flight.make_room()
-        with tl.stage("dispatch", byte_free=True):
-            p = beamform(
-                win.arrays, weights, mesh=mesh, axis=axis, nint=win.ntime,
-                detect=True, layout=layout,
-            )
-            acc = p if acc is None else add(acc, p)
-        flight.admit(win, p)
-    if acc is None:
-        raise ValueError("beamform_accumulate: feed yielded no windows")
-    with tl.stage("device", byte_free=True):
-        acc.block_until_ready()
-    # The terminal sync above proved every fold complete — release the
-    # tail without a second wait.
-    flight.drain(synced=True)
+    with observability.span("beamform.accumulate"):
+        for win in feed:
+            if win.masked:
+                tl.count("masked_antennas", len(win.masked))
+            # Lag-1 (shared FoldInFlight core, ISSUE 4): wait for the
+            # previous window's fold (its power output implies its input
+            # was consumed) and recycle its slot BEFORE dispatching the
+            # next fold.
+            flight.make_room()
+            with tl.stage("dispatch", byte_free=True):
+                p = beamform(
+                    win.arrays, weights, mesh=mesh, axis=axis,
+                    nint=win.ntime, detect=True, layout=layout,
+                )
+                acc = p if acc is None else add(acc, p)
+            flight.admit(win, p)
+        if acc is None:
+            raise ValueError("beamform_accumulate: feed yielded no windows")
+        with tl.stage("device", byte_free=True):
+            acc.block_until_ready()
+        # The terminal sync above proved every fold complete — release the
+        # tail without a second wait.
+        flight.drain(synced=True)
     return acc
 
 

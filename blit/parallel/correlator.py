@@ -421,46 +421,50 @@ def correlate_stream(
         )
     from blit.outplane import FoldInFlight
 
+    from blit import observability
+
     tl = timeline if timeline is not None else Timeline()
     accr = acci = None
     flight = FoldInFlight(tl, depth=1)
-    for win in feed:
-        if win.masked:
-            # Degraded continuation: the band-sharded accumulator folds
-            # this window with the failed antenna zero-weighted; the flag
-            # rides the driver's stage tables and the feed's metadata
-            # (``masked_antennas`` / header ``_masked_antennas``).
-            tl.count("masked_antennas", len(win.masked))
-        vr, vi = win.arrays
-        # Lag-1 sync (shared FoldInFlight core, ISSUE 4): wait for window
-        # w-1's fold only now — the feed already moved window w and is
-        # reading w+1 behind it.  The synced fold consumed w-1's arrays,
-        # so its slot can refill (Window.release contract).  Must happen
-        # BEFORE the next dispatch: _accum_vis donates the accumulator,
-        # and a donated token can no longer be waited on.
-        flight.make_room()
-        with tl.stage("dispatch", byte_free=True):
-            if accr is None:
-                accr, acci = _window_vis(
-                    vr, vi, coeffs, mesh=mesh, vis_layout=vis_layout
-                )
-            else:
-                accr, acci = _accum_vis(
-                    accr, acci, vr, vi, coeffs,
-                    mesh=mesh, vis_layout=vis_layout,
-                )
-        flight.admit(win, accr)
-    if accr is None:
-        raise ValueError("correlate_stream: feed yielded no windows")
-    with tl.stage("device", byte_free=True):
-        visr, visi = _finish_vis(
-            accr, acci, mesh=mesh, vis_layout=vis_layout
-        )
-        jax.block_until_ready((visr, visi))
-    # The finish fetch just proved every fold complete — release the last
-    # window without the old second sync of the accumulator (ISSUE 4:
-    # "double sync today").
-    flight.drain(synced=True)
+    with observability.span("correlate.stream"):
+        for win in feed:
+            if win.masked:
+                # Degraded continuation: the band-sharded accumulator folds
+                # this window with the failed antenna zero-weighted; the flag
+                # rides the driver's stage tables and the feed's metadata
+                # (``masked_antennas`` / header ``_masked_antennas``).
+                tl.count("masked_antennas", len(win.masked))
+            vr, vi = win.arrays
+            # Lag-1 sync (shared FoldInFlight core, ISSUE 4): wait for window
+            # w-1's fold only now — the feed already moved window w and is
+            # reading w+1 behind it.  The synced fold consumed w-1's arrays,
+            # so its slot can refill (Window.release contract).  Must happen
+            # BEFORE the next dispatch: _accum_vis donates the accumulator,
+            # and a donated token can no longer be waited on.
+            flight.make_room()
+            with observability.span("correlate.window", i=win.index), \
+                    tl.stage("dispatch", byte_free=True):
+                if accr is None:
+                    accr, acci = _window_vis(
+                        vr, vi, coeffs, mesh=mesh, vis_layout=vis_layout
+                    )
+                else:
+                    accr, acci = _accum_vis(
+                        accr, acci, vr, vi, coeffs,
+                        mesh=mesh, vis_layout=vis_layout,
+                    )
+            flight.admit(win, accr)
+        if accr is None:
+            raise ValueError("correlate_stream: feed yielded no windows")
+        with tl.stage("device", byte_free=True):
+            visr, visi = _finish_vis(
+                accr, acci, mesh=mesh, vis_layout=vis_layout
+            )
+            jax.block_until_ready((visr, visi))
+        # The finish fetch just proved every fold complete — release the last
+        # window without the old second sync of the accumulator (ISSUE 4:
+        # "double sync today").
+        flight.drain(synced=True)
     return visr, visi
 
 

@@ -31,10 +31,22 @@ from concurrent.futures import TimeoutError as _FutTimeout
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
-from blit import faults
+from blit import faults, observability
 from blit.config import DEFAULT, SiteConfig
 
 log = logging.getLogger("blit.pool")
+
+
+def _traced_call(ctx, wid: int, host: str, fn: Callable, args, kw):
+    """Executor-side wrapper for the in-process backends: adopt the
+    driver's trace context (thread-locals do not flow into pool threads)
+    and record the dispatch as a child span.  Module-level so the process
+    backend can pickle it."""
+    tr = observability.tracer()
+    with tr.activate(ctx):
+        with tr.span(f"pool.{getattr(fn, '__name__', 'call')}",
+                     worker=wid, host=host):
+            return fn(*args, **kw)
 
 # Distinguishes "not given" (inherit SiteConfig) from an explicit None
 # (disable the deadline — the reference's blocking behavior).
@@ -124,12 +136,31 @@ class WorkerPool:
         elif backend == "process":
             self._exec = ProcessPoolExecutor()
         if backend == "remote":
+            import os
+
             from blit.parallel.remote import RemoteWorker, ssh_command
 
             make_cmd = transport or ssh_command
             for w in self.workers:
+                # Stamp the agent's identity so its log records and
+                # telemetry snapshots carry the worker id (blit/agent.py
+                # main reads BLIT_WORKER_ID — ISSUE 5 satellite).  Two
+                # routes, because sshd does NOT forward the client's
+                # environment: transports that accept ``remote_env``
+                # (ssh_command) splice an ``env K=V`` prefix into the
+                # remote command line; the local subprocess env below
+                # covers direct transports (tests, same-host agents).
+                stamp = {"BLIT_WORKER_ID": str(w.wid)}
+                if os.environ.get("BLIT_LOG_JSON"):
+                    stamp["BLIT_LOG_JSON"] = os.environ["BLIT_LOG_JSON"]
+                try:
+                    cmd = make_cmd(w.host, remote_env=stamp)
+                except TypeError:  # transport without remote_env support
+                    cmd = make_cmd(w.host)
+                env = dict(agent_env if agent_env is not None else os.environ)
+                env.update(stamp)
                 w.remote = RemoteWorker(
-                    w.host, make_cmd(w.host), env=agent_env,
+                    w.host, cmd, env=env,
                     call_timeout=self.call_timeout,
                     ping_timeout=self.ping_timeout,
                 )
@@ -159,13 +190,27 @@ class WorkerPool:
         ]
 
     # -- execution --------------------------------------------------------
-    def _remote_call(self, w: _Worker, fn: Callable, /, *args, **kw):
+    def _remote_call(self, w: _Worker, fn: Callable, ctx, /, *args, **kw):
         """One remote dispatch under the recovery policy: retry transient
         worker-loss failures (``AgentDied``/``CallTimeout`` — the next
         ``RemoteWorker.call`` respawns the agent) with jittered backoff,
         feeding the per-host circuit breaker.  A tripped breaker fails
         fast with ``RemoteError(etype="HostDegraded")`` until its cooldown
-        probe — repeated failures must degrade the host, not hammer it."""
+        probe — repeated failures must degrade the host, not hammer it.
+
+        ``ctx`` is the driver's trace context captured at submit time:
+        the whole dispatch (attempts included) records as one child span,
+        and :meth:`blit.parallel.remote.RemoteWorker.call` ships the
+        span's context over the wire so the agent's spans parent onto it
+        (ISSUE 5 tentpole #1)."""
+        tr = observability.tracer()
+        with tr.activate(ctx), tr.span(
+            f"pool.{getattr(fn, '__name__', 'call')}",
+            worker=w.wid, host=w.host,
+        ):
+            return self._remote_call_inner(w, fn, *args, **kw)
+
+    def _remote_call_inner(self, w: _Worker, fn: Callable, /, *args, **kw):
         from blit.parallel.remote import RemoteError
 
         br = w.breaker
@@ -181,6 +226,14 @@ class WorkerPool:
             try:
                 result = w.remote.call(fn, *args, **kw)
             except RemoteError as e:
+                if e.etype == "AgentDied":
+                    # One of the flight recorder's trip conditions
+                    # (ISSUE 5 tentpole #4): the incident evidence — the
+                    # recent span/stage/fault ring — is dumped while it is
+                    # still recent.  Rate-limited inside dump().
+                    observability.flight_recorder().dump(
+                        f"agent for worker {w.wid} ({w.host}) died: {e}"
+                    )
                 if br.record_failure():
                     faults.incr("breaker.trip")
                     log.error(
@@ -188,6 +241,11 @@ class WorkerPool:
                         "%d consecutive failures (%s); host degraded for "
                         "%.0fs", w.wid, w.host, br.failures, e.etype,
                         br.cooldown_s,
+                    )
+                    observability.flight_recorder().dump(
+                        f"circuit breaker tripped for worker {w.wid} "
+                        f"({w.host}) after {br.failures} consecutive "
+                        f"failures ({e.etype})"
                     )
                 transient = e.etype in ("AgentDied", "CallTimeout")
                 # br.closed() is the non-consuming check: once the breaker
@@ -209,17 +267,26 @@ class WorkerPool:
     def _submit(self, worker: _Worker, fn: Callable, /, *args, **kw) -> Future:
         """Dispatch one call for ``worker``.  Shared-filesystem backends run
         it anywhere; the remote backend routes it to that worker's host —
-        the reference's ``@spawnat worker`` placement (src/gbt.jl:54-57)."""
+        the reference's ``@spawnat worker`` placement (src/gbt.jl:54-57).
+
+        The caller's ambient trace context is captured HERE (the submit
+        thread) and re-activated executor-side, so every backend's
+        dispatch records as a child span of the driver operation that
+        fanned it out."""
+        ctx = observability.tracer().context()
         if worker.remote is not None:
-            return self._exec.submit(self._remote_call, worker, fn, *args, **kw)
+            return self._exec.submit(
+                self._remote_call, worker, fn, ctx, *args, **kw)
         if self._exec is None:
             f: Future = Future()
             try:
-                f.set_result(fn(*args, **kw))
+                f.set_result(
+                    _traced_call(ctx, worker.wid, worker.host, fn, args, kw))
             except Exception as e:  # noqa: BLE001 - captured per-call
                 f.set_exception(e)
             return f
-        return self._exec.submit(fn, *args, **kw)
+        return self._exec.submit(
+            _traced_call, ctx, worker.wid, worker.host, fn, args, kw)
 
     def run_on(
         self,
@@ -320,6 +387,38 @@ class WorkerPool:
                         later.cancel()
                     raise e
         return results
+
+    def harvest_telemetry(self, timeout: Optional[float] = None,
+                          reset: bool = False) -> Dict[str, object]:
+        """Pull every worker's telemetry (Timeline state, fault counters,
+        spans — :func:`blit.observability.telemetry_snapshot`) and fold it
+        with the driver's own into ONE per-host-keyed fleet report
+        (ISSUE 5 tentpole #3).
+
+        Harvest failures degrade, never abort: a host that cannot answer
+        lands under ``report["errors"]`` and the rest of the fleet still
+        reports.  ``reset=True`` zeroes each worker's telemetry after
+        snapshotting (interval-scrape mode).  The report also carries
+        :meth:`health` so a degraded run says so in the same document."""
+        results = self.broadcast(
+            observability.telemetry_snapshot,
+            kwargs_per_worker=lambda w: {"reset": reset},
+            on_error="capture", timeout=timeout,
+        )
+        errors: Dict[str, str] = {}
+        snaps = []
+        for w, r in zip(self.workers, results):
+            if isinstance(r, WorkerError):
+                errors[w.host] = repr(r.error)
+            else:
+                snaps.append(r)
+        # The driver's own telemetry rides along; with the in-process
+        # backends it is the same (host, pid) as the workers' answers and
+        # merge_fleet's dedupe counts it once.
+        snaps.append(observability.telemetry_snapshot())
+        report = observability.merge_fleet(snaps, errors=errors or None)
+        report["health"] = self.health()
+        return report
 
     def shutdown(self):
         # Drain in-flight calls BEFORE closing agents — a queued remote call

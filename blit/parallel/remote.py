@@ -24,7 +24,7 @@ import threading
 import time
 from typing import Callable, List, Optional, Sequence
 
-from blit import faults
+from blit import faults, observability
 from blit.agent import MAGIC, _SAFE_GLOBALS_RESPONSE, read_msg, write_msg
 
 log = logging.getLogger("blit.remote")
@@ -75,6 +75,7 @@ def ssh_command(
     host: str,
     python: str = "python3",
     ssh_opts: Sequence[str] = ("-o", "BatchMode=yes"),
+    remote_env: Optional[dict] = None,
 ) -> List[str]:
     """The production transport: ``ssh <host> <python> -m blit.agent``.
 
@@ -83,8 +84,16 @@ def ssh_command(
     the packaged install (pyproject.toml) is the analog of the
     reference's shared ``@BLDistributedDataProducts`` project environment
     (src/gbt.jl:17).  ``agent_env_with_repo`` remains a dev/test
-    convenience for uninstalled checkouts."""
-    return ["ssh", *ssh_opts, host, python, "-m", "blit.agent"]
+    convenience for uninstalled checkouts.
+
+    ``remote_env`` entries are injected as an ``env K=V ...`` prefix in
+    the REMOTE command — sshd does not forward arbitrary client
+    environment variables, so identity stamps like ``BLIT_WORKER_ID``
+    (ISSUE 5) must ride the command line to reach the agent."""
+    prefix: List[str] = []
+    if remote_env:
+        prefix = ["env"] + [f"{k}={v}" for k, v in sorted(remote_env.items())]
+    return ["ssh", *ssh_opts, host, *prefix, python, "-m", "blit.agent"]
 
 
 def local_agent_command() -> List[str]:
@@ -308,8 +317,19 @@ class RemoteWorker:
 
     def call(self, fn: Callable, *args, **kwargs):
         """Invoke ``fn`` (a blit callable) on the remote host, bounded by
-        ``call_timeout``."""
+        ``call_timeout``.
+
+        Trace propagation (ISSUE 5): when the calling thread is inside a
+        span, its ``{"trace", "span"}`` context rides the request as the
+        reserved ``_blit_trace`` kwarg — :func:`blit.agent.serve` strips
+        it before invoking the worker function and opens the worker-side
+        span under it, so the fan-out's remote spans parent onto the
+        driver's."""
         fn_path = f"{fn.__module__}.{fn.__qualname__}"
+        ctx = observability.tracer().context()
+        if ctx is not None:
+            kwargs = dict(kwargs)
+            kwargs["_blit_trace"] = ctx
         try:
             # Transport-level injection point: a "fail" rule here looks to
             # the pool exactly like the agent dying mid-call (the retry /

@@ -42,6 +42,7 @@ from typing import Dict, Iterator, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
+from blit import observability
 from blit.io.guppi import GuppiRaw, RawSource, open_raw
 from blit.observability import Timeline, profile_trace
 from blit.ops.channelize import (
@@ -194,24 +195,32 @@ class BufferRotation:
                     item = self._filled.get(timeout=poll)
                 except queue.Empty:
                     if self._held >= self.nslots:
-                        raise RuntimeError(
+                        msg = (
                             f"BufferRotation starved: all {self.nslots} "
                             "slots are held unreleased by the consumer — "
                             "release() earlier chunks/windows before "
                             "requesting more, or raise prefetch_depth"
                         )
+                        observability.flight_recorder().dump(msg)
+                        raise RuntimeError(msg)
                     if (
                         self.stall_timeout_s is not None
                         and self._thread.is_alive()
                         and time.monotonic() - self._beat
                         > self.stall_timeout_s
                     ):
-                        raise RuntimeError(
+                        msg = (
                             f"{self._thread.name}: producer stalled — no "
                             f"progress for > {self.stall_timeout_s}s "
                             "(stall watchdog; a wedged read would "
                             "otherwise hang the stream)"
                         )
+                        # The incident trail — recent span/stage/fault
+                        # events — is dumped BEFORE the raise unwinds and
+                        # teardown noise overwrites the ring (ISSUE 5
+                        # tentpole #4).
+                        observability.flight_recorder().dump(msg)
+                        raise RuntimeError(msg)
                     continue
                 if item is None:
                     return
@@ -377,7 +386,9 @@ class RawReducer:
         caller's to keep (never recycled under it); slab VALUES are
         byte-identical to the synchronous path's.
         """
-        with profile_trace(self.trace_logdir):
+        with profile_trace(self.trace_logdir), observability.span(
+            "reduce.stream", nfft=self.nfft, path=getattr(raw, "path", "")
+        ):
             if not self.async_output:
                 for chunk in self._chunks(raw, skip_frames):
                     try:
@@ -473,7 +484,10 @@ class RawReducer:
             stall_timeout_s=self.output_stall_timeout_s,
         )
         try:
-            with profile_trace(self.trace_logdir):
+            with profile_trace(self.trace_logdir), observability.span(
+                "reduce.pump", nfft=self.nfft,
+                out=str(getattr(writer, "path", "")),
+            ):
                 for slab in self._stream_async(raw, skip_frames,
                                                reuse=True):
                     sink.append(slab.data, release=slab.release)
@@ -682,7 +696,8 @@ class RawReducer:
         scan sequence (path list / stem, blit/io/guppi.open_raw) — in memory
         → ``(filterbank_header, data)`` with data ``(nsamps, nif, nchans)``."""
         raw, hdr = self._open_validated(raw_src)
-        slabs = list(self.stream(raw))
+        with observability.span("reduce", nfft=self.nfft):
+            slabs = list(self.stream(raw))
         if slabs:
             data = np.concatenate(slabs, axis=0)
         else:
@@ -719,7 +734,8 @@ class RawReducer:
                 out_path, hdr, nifs=nif, nchans=hdr["nchans"],
                 compression=compression, chunks=chunks,
             )
-            hdr["nsamps"] = self._pump(raw, w)
+            with observability.span("reduce.to_file", out=out_path):
+                hdr["nsamps"] = self._pump(raw, w)
             return hdr
         if compression is not None:
             raise ValueError(".fil products are uncompressed; compression "
@@ -737,7 +753,8 @@ class RawReducer:
         # Resumable partial products are reduce_resumable's job — there the
         # cursor sidecar marks incompleteness.
         w = FilWriter(out_path, hdr, nif, hdr["nchans"])
-        hdr["nsamps"] = self._pump(raw, w)
+        with observability.span("reduce.to_file", out=out_path):
+            hdr["nsamps"] = self._pump(raw, w)
         return hdr
 
     def reduce_resumable(self, raw_src: RawSource, out_path: str,
@@ -830,8 +847,10 @@ class RawReducer:
         # resume point (the writer's own crash contract); under the async
         # plane the cursor may simply sit a few queued-but-unwritten slabs
         # earlier, which the skip-frames replay re-reduces identically.
-        hdr["nsamps"] = self._pump(raw, w,
-                                   skip_frames=start_rows * self.nint)
+        with observability.span("reduce.resumable", out=out_path,
+                                resumed=bool(resuming)):
+            hdr["nsamps"] = self._pump(raw, w,
+                                       skip_frames=start_rows * self.nint)
         return hdr
 
 

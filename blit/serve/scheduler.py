@@ -22,7 +22,9 @@ machinery.  Design points, in the order a request meets them:
   instead of piling the same load onto the surviving hosts.
 - **Observability** — queue-depth and per-job wait gauges land on the
   :class:`~blit.observability.Timeline` (``sched.queue_depth`` /
-  ``sched.wait_s``), wait samples are kept for p50/p99 reporting, and the
+  ``sched.wait_s``), the wait distribution lives in a bounded
+  :class:`~blit.observability.HistogramStats` (p50/p99 at fixed memory
+  for the life of the scheduler — ISSUE 5 satellite), and the
   ``sched.dispatch`` fault-injection point covers the dispatch path so
   drills (blit/faults.py) reach the serving layer.
 
@@ -37,10 +39,10 @@ import logging
 import threading
 import time
 from collections import deque
-from typing import Callable, Deque, Dict, List, Optional
+from typing import Callable, Deque, Dict, Optional
 
 from blit import faults
-from blit.observability import Timeline
+from blit.observability import HistogramStats, Timeline
 
 log = logging.getLogger("blit.serve.sched")
 
@@ -133,7 +135,11 @@ class Scheduler:
         # EWMA of job service seconds — the wait estimator's unit cost.
         self._svc_ewma = 0.0
         self._svc_n = 0
-        self.wait_samples: Deque[float] = deque(maxlen=4096)
+        # Bounded wait distribution (ISSUE 5 satellite): the old per-sample
+        # list grew for the life of the scheduler; HistogramStats holds 64
+        # counters forever, merges into fleet reports, and keeps the
+        # {"p50","p99","n"} report shape.
+        self.wait_hist = HistogramStats()
         self.counts: Dict[str, int] = {
             "submitted": 0, "dispatched": 0, "rejected": 0,
             "cancelled": 0, "failed": 0,
@@ -262,8 +268,9 @@ class Scheduler:
             self._running += 1
             self.counts["dispatched"] += 1
             wait = job.started_at - job.submitted_at
-            self.wait_samples.append(wait)
+            self.wait_hist.observe(wait)
             self.timeline.gauge("sched.wait_s", wait)
+            self.timeline.observe("sched.wait_s", wait)
             threading.Thread(
                 target=self._run, args=(job,),
                 name=f"blit-serve-{job.client}", daemon=True,
@@ -318,20 +325,16 @@ class Scheduler:
         return True
 
     def wait_percentiles(self) -> Dict[str, float]:
-        """p50/p99 of the recorded queue waits (seconds; 0 when empty)."""
+        """p50/p99 of the recorded queue waits (seconds; 0 when empty) —
+        bucket estimates from the bounded histogram (good to a factor of
+        2), same ``{"p50","p99","n"}`` shape as the old exact-sample
+        report."""
         with self._lock:
-            # Snapshot under the lock: a concurrent dispatch appending to
-            # the deque mid-sort would raise "deque mutated during
-            # iteration" out of a read-only stats call.
-            samples: List[float] = sorted(self.wait_samples)
-        if not samples:
-            return {"p50": 0.0, "p99": 0.0, "n": 0}
-
-        def pct(p: float) -> float:
-            i = min(len(samples) - 1, int(round(p * (len(samples) - 1))))
-            return samples[i]
-
-        return {"p50": pct(0.50), "p99": pct(0.99), "n": len(samples)}
+            # Under the lock: observe() runs inside _dispatch_locked, so
+            # the counts/envelope pair stays consistent for the walk.
+            h = self.wait_hist
+            return {"p50": h.percentile(0.50), "p99": h.percentile(0.99),
+                    "n": h.n}
 
     def close(self, timeout: Optional[float] = 30.0) -> None:
         """Refuse new work and wait for queued+running jobs to drain."""

@@ -35,6 +35,7 @@ from typing import Dict, List, Optional, Tuple, Union
 
 import numpy as np
 
+from blit import observability
 from blit.config import DEFAULT, SiteConfig
 from blit.observability import Timeline
 from blit.serve.cache import ProductCache, fingerprint_for
@@ -196,9 +197,15 @@ class ProductService:
             t = Ticket(fp, client, "scheduled", _flight=flight)
             flight.tickets.append(t)
             self._flights[fp] = flight
+            # Capture the submitter's trace context NOW: the reduction
+            # runs later on a scheduler job thread, and its span must
+            # parent onto the request that scheduled it (ISSUE 5) — N
+            # coalesced callers all point at this one flight span tree.
+            ctx = observability.tracer().context()
             try:
                 flight.job = self.scheduler.submit(
-                    lambda: self._reduce_and_publish(fp, request, flight),
+                    lambda: self._reduce_and_publish(fp, request, flight,
+                                                     ctx),
                     priority=priority, client=client, deadline_s=deadline_s,
                 )
             except BaseException as e:
@@ -214,12 +221,16 @@ class ProductService:
         return t
 
     def _reduce_and_publish(
-        self, fp: str, request: ProductRequest, flight: _Flight
+        self, fp: str, request: ProductRequest, flight: _Flight, ctx=None
     ) -> Tuple[Dict, np.ndarray]:
         """The scheduled job body: run the reduction, publish to the
-        cache, fulfill (or fail) every ticket on the flight."""
+        cache, fulfill (or fail) every ticket on the flight.  ``ctx`` is
+        the submitter's trace context — the job thread adopts it so the
+        reduction's spans parent onto the request."""
+        tr = observability.tracer()
         try:
-            with self.timeline.stage("serve.reduce", byte_free=True):
+            with tr.activate(ctx), tr.span("serve.reduce", fp=fp[:16]), \
+                    self.timeline.stage("serve.reduce", byte_free=True):
                 header, data = request.reducer().reduce(request.raw_source)
             data = self.cache.put(fp, header, data)
             self._finish(fp, flight, result=(header, data))

@@ -176,8 +176,14 @@ def reduce_raw(
     ``resume=True`` (with a ``.fil`` out_path) restarts an interrupted
     reduction from its cursor sidecar (blit/pipeline.py ReductionCursor).
     """
+    from blit.observability import process_timeline
     from blit.pipeline import RawReducer, reducer_for_product
 
+    # Fan-out reductions record on the process-wide timeline by default
+    # (ISSUE 5 tentpole #3): this is what ``WorkerPool.harvest_telemetry``
+    # pulls back from each worker, so a remote reduction's stage table is
+    # visible from the driver.  Callers can still pass their own.
+    reducer_kw.setdefault("timeline", process_timeline())
     if product is not None:
         if nfft != 1024 or nint != 1:
             raise ValueError(

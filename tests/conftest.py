@@ -38,6 +38,17 @@ import logging
 import pytest
 
 
+def pytest_sessionfinish(session, exitstatus):
+    """Publish the run's merged telemetry report (ISSUE 5 CI satellite):
+    when BLIT_TELEMETRY_OUT is set (the tier-1 CI job points it at a
+    workspace file uploaded as an artifact), the whole suite's process
+    timeline, fault counters and spans land there as one fleet report."""
+    if os.environ.get("BLIT_TELEMETRY_OUT"):
+        from blit import observability
+
+        observability.maybe_write_report()
+
+
 @pytest.fixture
 def blit_logger_restored():
     """Snapshot + restore the 'blit' logger around tests that call

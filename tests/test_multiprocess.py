@@ -85,7 +85,14 @@ def _run_pod(outdir, extra_args=(), child=CHILD):
     return outs
 
 
+@pytest.mark.flaky(reruns=1)
 def test_two_process_pod_matches_single_process(tmp_path):
+    # ISSUE 5 satellite: this pod test is known to stall under load (the
+    # localhost coordinator / gloo bring-up races the 240 s child budget
+    # on saturated runners) — ONE auto-rerun via pytest-rerunfailures,
+    # scoped to this test only, absorbs the transient without masking a
+    # real regression (a deterministic failure still fails both runs).
+    # The marker is inert where the plugin isn't installed.
     outdir = str(tmp_path / "pod")
     os.makedirs(outdir)
     outs = _run_pod(outdir)

@@ -1,0 +1,722 @@
+"""Telemetry plane (ISSUE 5): spans + fan-out trace propagation,
+log-bucketed histograms, fleet harvest/merge, the flight recorder, JSON
+logging, and the telemetry/trace-view CLI.
+
+Includes the ISSUE 5 Timeline-concurrency satellite: merge() is
+commutative/associative on disjoint and overlapping stage keys, report()
+survives producer-thread stage insertion, and reset() preserves object
+identity (the BENCH_r05 "0 bytes" regression pin).
+"""
+
+import json
+import logging
+import threading
+import time
+from io import StringIO
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from blit import faults, observability  # noqa: E402
+from blit.observability import (  # noqa: E402
+    HistogramStats,
+    Timeline,
+    configure_logging,
+    merge_fleet,
+    render_fleet_text,
+    render_flight_dump,
+    render_prometheus,
+    telemetry_snapshot,
+)
+from blit.parallel.pool import WorkerPool  # noqa: E402
+from blit.parallel.remote import (  # noqa: E402
+    agent_env_with_repo,
+    local_agent_command,
+)
+from blit.testing import synth_raw  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def clean_telemetry():
+    """Drain the process-global tracer/flight ring around each test (the
+    process timeline and fault counters are cumulative by design — tests
+    assert deltas or structure, never absolute totals)."""
+    tr = observability.tracer()
+    was_enabled = tr.enabled
+    tr.enabled = True
+    tr.reset()
+    observability.flight_recorder().clear()
+    yield
+    tr.enabled = was_enabled
+    tr.reset()
+    observability.flight_recorder().clear()
+
+
+def local_transport(host):
+    return local_agent_command()
+
+
+# -- histograms -------------------------------------------------------------
+
+
+class TestHistogramStats:
+    def test_quantiles_within_one_bucket(self):
+        h = HistogramStats()
+        for v in [0.001] * 90 + [1.0] * 10:
+            h.observe(v)
+        r = h.report()
+        assert r["n"] == 100
+        # Log2 buckets: estimates are good to a factor of 2.
+        assert 0.0005 <= r["p50"] <= 0.002
+        assert 0.5 <= r["p99"] <= 2.0
+        assert r["max"] == 1.0  # exact envelope, never a bucket estimate
+
+    def test_bounded_memory(self):
+        h = HistogramStats()
+        for i in range(100_000):
+            h.observe((i % 1000) * 1e-4)
+        assert len(h.counts) == 64
+        assert h.n == 100_000
+
+    def test_merge_commutative(self):
+        a, b = HistogramStats(), HistogramStats()
+        for v in (0.01, 0.02, 5.0):
+            a.observe(v)
+        for v in (1e-7, 0.3):
+            b.observe(v)
+        ab = HistogramStats().merge(a).merge(b)
+        ba = HistogramStats().merge(b).merge(a)
+        assert ab.state() == ba.state()
+        assert ab.n == 5 and ab.vmin == 1e-7 and ab.vmax == 5.0
+
+    def test_state_roundtrip_is_exact(self):
+        h = HistogramStats()
+        for v in (0.004, 0.2, 7.0):
+            h.observe(v)
+        st = json.loads(json.dumps(h.state()))  # survives the wire
+        assert HistogramStats.from_state(st).state() == h.state()
+
+    def test_empty(self):
+        r = HistogramStats().report()
+        assert r == {"n": 0, "mean": 0.0, "p50": 0.0, "p90": 0.0,
+                     "p99": 0.0, "max": 0.0}
+
+
+# -- Timeline merge / concurrency (ISSUE 5 satellite) ----------------------
+
+
+def _tl(stages=(), counts=(), gauges=(), hists=()):
+    tl = Timeline()
+    for name, calls, seconds, nbytes in stages:
+        s = tl.stages[name]
+        s.calls, s.seconds, s.bytes = calls, seconds, nbytes
+    for name, n in counts:
+        tl.count(name, n)
+    for name, v in gauges:
+        tl.gauge(name, v)
+    for name, v in hists:
+        tl.observe(name, v)
+    return tl
+
+
+class TestTimelineMerge:
+    def test_merge_commutative_disjoint_and_overlapping(self):
+        def mk_a():
+            return _tl(stages=[("ingest", 2, 1.0, 100), ("device", 1, 0.5, 50)],
+                       hists=[("lat", 0.01)])
+
+        def mk_b():
+            # Overlaps "ingest", disjoint "write".
+            return _tl(stages=[("ingest", 3, 2.0, 300), ("write", 4, 0.25, 70)],
+                       hists=[("lat", 0.04), ("wait", 1.0)])
+
+        ab = Timeline().merge(mk_a()).merge(mk_b())
+        ba = Timeline().merge(mk_b()).merge(mk_a())
+        assert ab.state()["stages"] == ba.state()["stages"]
+        assert ab.state()["hists"] == ba.state()["hists"]
+        assert ab.stages["ingest"].calls == 5
+        assert ab.stages["ingest"].bytes == 400
+        assert ab.stages["write"].calls == 4
+        assert ab.hists["lat"].n == 2
+
+    def test_merge_associative(self):
+        def mk(i):
+            return _tl(stages=[("s", i, float(i), 10 * i),
+                               (f"only{i}", 1, 0.1, 1)],
+                       hists=[("h", 0.001 * (i + 1))])
+
+        left = Timeline().merge(Timeline().merge(mk(1)).merge(mk(2))).merge(mk(3))
+        right = Timeline().merge(mk(1)).merge(Timeline().merge(mk(2)).merge(mk(3)))
+        assert left.state()["stages"] == right.state()["stages"]
+        assert left.state()["hists"] == right.state()["hists"]
+
+    def test_merge_byte_free_and_gauges(self):
+        a = _tl(counts=[("retry", 2)], gauges=[("depth", 3.0)])
+        b = _tl(gauges=[("depth", 9.0)])
+        a.merge(b)
+        assert a.stages["retry"].byte_free
+        g = a.gauges["depth"]
+        assert g.n == 2 and g.lo == 3.0 and g.hi == 9.0
+
+    def test_state_roundtrip(self):
+        tl = _tl(stages=[("x", 7, 1.25, 99)], counts=[("c", 3)],
+                 gauges=[("g", 0.5)], hists=[("h", 0.02)])
+        st = json.loads(json.dumps(tl.state()))
+        back = Timeline.from_state(st)
+        assert back.state() == tl.state()
+        assert back.stages["c"].byte_free
+
+    def test_report_safe_under_producer_insertion(self):
+        """ISSUE 5 satellite: report() must never raise while a producer
+        thread is inserting new stage keys (the window feeds do exactly
+        this during consumer-side reporting)."""
+        tl = Timeline()
+        stop = threading.Event()
+        errs = []
+
+        def producer():
+            i = 0
+            try:
+                while not stop.is_set():
+                    with tl.stage(f"s{i % 501}", nbytes=1):
+                        pass
+                    tl.observe(f"h{i % 97}", 1e-4)
+                    i += 1
+            except Exception as e:  # noqa: BLE001 — reported to the assert
+                errs.append(e)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        try:
+            deadline = time.monotonic() + 1.0
+            while time.monotonic() < deadline:
+                rep = tl.report()
+                assert isinstance(rep, dict)
+                tl.state()
+                tl.snapshot()
+        finally:
+            stop.set()
+            t.join(5)
+        assert not errs
+
+    def test_reset_preserves_identity_bench_r05_shape(self):
+        """Regression pin (BENCH_r05 "stream bytes: 0"): a thread holding
+        a StageStats/HistogramStats across reset() must keep feeding the
+        SAME objects the report reads."""
+        tl = Timeline()
+        with tl.stage("stream", nbytes=100):
+            pass
+        tl.observe("lat", 0.5)
+        held_stage = tl.stages["stream"]
+        held_hist = tl.hists["lat"]
+        tl.reset()
+        assert tl.stages["stream"] is held_stage
+        assert tl.hists["lat"] is held_hist
+        held_stage.bytes += 42
+        held_hist.observe(0.25)
+        rep = tl.report()
+        assert rep["stream"]["bytes"] == 42
+        assert rep["hists"]["lat"]["n"] == 1
+
+
+# -- spans ------------------------------------------------------------------
+
+
+class TestSpans:
+    def test_nesting_and_trace_linkage(self):
+        tr = observability.tracer()
+        with tr.span("outer") as outer:
+            with tr.span("inner", k="v") as inner:
+                assert tr.context() == {"trace": inner.trace_id,
+                                        "span": inner.span_id}
+        spans = {s.name: s for s in tr.spans()}
+        assert spans["inner"].parent_id == spans["outer"].span_id
+        assert spans["inner"].trace_id == spans["outer"].trace_id
+        assert spans["outer"].parent_id is None
+        assert spans["inner"].attrs == {"k": "v"}
+        assert spans["inner"].duration_s >= 0.0
+
+    def test_disabled_tracer_records_nothing(self):
+        tr = observability.tracer()
+        tr.enabled = False
+        with tr.span("x") as sp:
+            assert sp is None
+        assert tr.context() is None
+        assert tr.spans() == []
+
+    def test_activate_adopts_cross_thread_context(self):
+        tr = observability.tracer()
+        with tr.span("driver"):
+            ctx = tr.context()
+        out = {}
+
+        def worker():
+            with tr.activate(ctx), tr.span("remote-leg") as sp:
+                out["span"] = sp
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join(5)
+        assert out["span"].trace_id == ctx["trace"]
+        assert out["span"].parent_id == ctx["span"]
+
+    def test_export_chrome_is_perfetto_shaped(self, tmp_path):
+        tr = observability.tracer()
+        with tr.span("a"):
+            with tr.span("b"):
+                pass
+        path = tr.export_chrome(str(tmp_path / "t.json"))
+        doc = json.load(open(path))
+        evs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+        assert {e["name"] for e in evs} == {"a", "b"}
+        for e in evs:
+            assert {"ts", "dur", "pid", "tid"} <= set(e)
+        meta = [e for e in doc["traceEvents"] if e.get("ph") == "M"]
+        assert meta and meta[0]["name"] == "process_name"
+
+    def test_export_chrome_dedupes_harvested_spans(self):
+        tr = observability.tracer()
+        with tr.span("a"):
+            pass
+        doc = tr.export_chrome(extra=tr.span_dicts())
+        assert len([e for e in doc["traceEvents"] if e.get("ph") == "X"]) == 1
+
+    def test_span_buffer_is_bounded(self):
+        tr = observability.Tracer(max_spans=8)
+        for i in range(100):
+            with tr.span(f"s{i}"):
+                pass
+        assert len(tr.spans()) == 8
+        assert tr.spans()[-1].name == "s99"
+
+
+# -- pool propagation + fleet harvest ---------------------------------------
+
+
+def _touch_process_timeline(tag="t"):
+    """Worker-side probe: records on the process timeline like real
+    worker entry points do (module-level so every backend can ship it)."""
+    from blit.observability import process_timeline
+
+    with process_timeline().stage(f"probe.{tag}", nbytes=10):
+        pass
+    return observability.tracer().context() is not None
+
+
+class TestPoolPropagation:
+    def test_thread_backend_spans_parent_onto_driver(self):
+        tr = observability.tracer()
+        with WorkerPool(["a", "b"], backend="thread") as pool:
+            with tr.span("fanout") as root:
+                res = pool.run_on([1, 2], _touch_process_timeline,
+                                  [("a",), ("b",)])
+        assert res == [True, True]  # ambient ctx visible worker-side
+        pool_spans = [s for s in tr.spans()
+                      if s.name == "pool._touch_process_timeline"]
+        assert len(pool_spans) == 2
+        assert all(s.parent_id == root.span_id for s in pool_spans)
+        assert {s.attrs["worker"] for s in pool_spans} == {1, 2}
+
+    def test_harvest_merges_thread_workers_once(self):
+        with WorkerPool(["a", "b"], backend="thread") as pool:
+            pool.run_on([1, 2], _touch_process_timeline,
+                        [("m1",), ("m1",)])
+            report = pool.harvest_telemetry()
+        host = observability.hostname()
+        assert list(report["hosts"]) == [host]
+        entry = report["hosts"][host]
+        # Both thread workers answer from the driver process: dedupe by
+        # (host, pid) counts the snapshot once, not three times.
+        assert len(entry["workers"]) == 1
+        assert entry["stages"]["probe.m1"]["calls"] == 2
+        assert "faults" in entry
+        assert report["fleet"]["probe.m1"]["calls"] == 2
+        assert "health" in report
+
+    def test_harvest_captures_dead_host_as_error(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("BLIT_FLIGHT_DIR", str(tmp_path))
+        faults.install(faults.FaultRule("remote.call", "fail", times=-1,
+                                        match="bad"))
+        try:
+            pool = WorkerPool(
+                ["bad"], backend="remote", transport=local_transport,
+                agent_env=agent_env_with_repo(),
+            )
+            try:
+                report = pool.harvest_telemetry(timeout=60)
+            finally:
+                pool.shutdown()
+        finally:
+            faults.clear()
+        assert "bad" in report.get("errors", {})
+        # The driver's own snapshot still reports.
+        assert observability.hostname() in report["hosts"]
+
+
+class TestRemoteFanOutAcceptance:
+    """ISSUE 5 acceptance: a multi-worker reduce_to_file run produces a
+    Perfetto-loadable trace whose worker spans parent onto the driver
+    span, and one merged per-host fleet report with every worker's stage
+    table and fault counters."""
+
+    def test_multiworker_reduce_trace_and_fleet_report(self, tmp_path):
+        from blit.workers import reduce_raw
+
+        faults.reset_counters()  # the host entry merges driver counters too
+        tr = observability.tracer()
+        argtuples = []
+        for i in range(2):
+            raw = str(tmp_path / f"in{i}.raw")
+            synth_raw(raw, nblocks=1, obsnchan=2, ntime_per_block=11 * 64,
+                      seed=i)
+            argtuples.append((raw, str(tmp_path / f"out{i}.fil")))
+        # One transient injected read failure per agent process: the
+        # harvested report must carry the workers' fault counters.
+        env = agent_env_with_repo()
+        env["BLIT_FAULTS"] = "guppi.read:fail:1"
+        pool = WorkerPool(
+            ["hA", "hB"], backend="remote", transport=local_transport,
+            agent_env=env,
+        )
+        try:
+            with tr.span("driver-reduce") as root:
+                pool.run_on([1, 2], reduce_raw, argtuples,
+                            kwargs={"nfft": 64})
+            report = pool.harvest_telemetry(timeout=120)
+        finally:
+            pool.shutdown()
+
+        # (b) one merged per-host fleet report: every worker's stage
+        # table (both agent pids under this host) and fault counters.
+        host = observability.hostname()
+        entry = report["hosts"][host]
+        pids = {w["pid"] for w in entry["workers"]}
+        assert len(pids) == 3  # 2 agents + the driver
+        assert {w["worker"] for w in entry["workers"]} >= {1, 2}
+        for stage in ("ingest", "stream", "device", "write"):
+            assert entry["stages"][stage]["calls"] >= 2, stage
+        assert entry["faults"].get("fault.guppi.read.fail", 0) == 2
+        assert entry["faults"].get("retry.io", 0) == 2
+        assert report["fleet"]["ingest"]["bytes"] > 0
+
+        # (a) Perfetto-loadable trace whose worker spans parent onto the
+        # driver span (via the per-worker pool dispatch spans).
+        trace_path = str(tmp_path / "trace.json")
+        tr.export_chrome(trace_path, extra=report["spans"])
+        doc = json.load(open(trace_path))
+        evs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+        by_id = {e["args"]["span"]: e for e in evs}
+        pool_spans = [e for e in evs if e["name"] == "pool.reduce_raw"]
+        agent_spans = [e for e in evs if e["name"] == "agent.reduce_raw"]
+        reduce_spans = [e for e in evs if e["name"] == "reduce.to_file"]
+        assert len(pool_spans) == 2 and len(agent_spans) == 2
+        assert len(reduce_spans) >= 2
+        for sp in pool_spans:
+            assert sp["args"]["parent"] == root.span_id
+        for sp in agent_spans:
+            parent = by_id[sp["args"]["parent"]]
+            assert parent["name"] == "pool.reduce_raw"
+            assert sp["args"]["trace"] == root.trace_id
+        for sp in reduce_spans:
+            assert by_id[sp["args"]["parent"]]["name"] == "agent.reduce_raw"
+
+
+class TestMergeFleet:
+    def test_per_host_keying_and_fault_sums(self):
+        def snap(host, pid, calls, nfaults):
+            tl = _tl(stages=[("ingest", calls, 1.0, 100 * calls)])
+            return {"host": host, "pid": pid, "worker": pid,
+                    "timeline": tl.state(),
+                    "faults": {"retry.io": nfaults}, "spans": []}
+
+        report = merge_fleet([snap("h1", 1, 2, 1), snap("h1", 2, 3, 2),
+                              snap("h2", 1, 5, 0), None,
+                              snap("h1", 1, 99, 99)])  # dup (host,pid)
+        assert set(report["hosts"]) == {"h1", "h2"}
+        assert report["hosts"]["h1"]["stages"]["ingest"]["calls"] == 5
+        assert report["hosts"]["h1"]["faults"]["retry.io"] == 3
+        assert report["hosts"]["h2"]["stages"]["ingest"]["calls"] == 5
+        assert report["fleet"]["ingest"]["calls"] == 10
+        assert report["faults"]["retry.io"] == 3
+
+    def test_renders(self):
+        report = merge_fleet([telemetry_snapshot()])
+        text = render_fleet_text(report)
+        assert "fleet:" in text
+        prom = render_prometheus(report)
+        assert "# TYPE blit_stage_seconds_total counter" in prom
+
+    def test_duplicate_pid_keeps_richest_snapshot(self):
+        """reset=True harvests on the thread backend: whichever worker's
+        snapshot call ran first drained the process telemetry, so the
+        later (empty) duplicates must not shadow the populated one."""
+        rich = {"host": "h", "pid": 1, "worker": 2,
+                "timeline": _tl(stages=[("ingest", 4, 1.0, 400)]).state(),
+                "faults": {}, "spans": [{"name": "x", "trace": "t",
+                                         "span": "s", "t0": 0.0,
+                                         "duration_s": 0.1}]}
+        empty = {"host": "h", "pid": 1, "worker": 1,
+                 "timeline": Timeline().state(), "faults": {}, "spans": []}
+        for order in ([empty, rich], [rich, empty]):
+            report = merge_fleet(order)
+            assert report["hosts"]["h"]["stages"]["ingest"]["calls"] == 4
+            assert len(report["spans"]) == 1
+
+    def test_thread_harvest_reset_keeps_the_run(self):
+        with WorkerPool(["a", "b"], backend="thread") as pool:
+            pool.run_on([1, 2], _touch_process_timeline, [("r",), ("r",)])
+            report = pool.harvest_telemetry(reset=True)
+        host = observability.hostname()
+        assert report["hosts"][host]["stages"]["probe.r"]["calls"] == 2
+
+
+# -- flight recorder --------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def test_stall_watchdog_dumps_and_trace_view_renders(
+            self, tmp_path, monkeypatch, capsys):
+        """ISSUE 5 acceptance: a forced stall leaves a dump that
+        `python -m blit trace-view` renders with the tripped watchdog
+        and the last events before the trip."""
+        from blit.__main__ import main
+        from blit.pipeline import BufferRotation
+
+        monkeypatch.setenv("BLIT_FLIGHT_DIR", str(tmp_path))
+        rec = observability.flight_recorder()
+        monkeypatch.setattr(rec, "min_interval_s", 0.0)
+        rec.event("fault", "drill.before-the-trip", n=1)
+
+        def wedged(rot):
+            rot.acquire()
+            time.sleep(1.2)  # wedged past the watchdog, then exits
+
+        rot = BufferRotation(2, wedged, name="blit-drill-feed",
+                             stall_timeout_s=0.2)
+        with pytest.raises(RuntimeError, match="stall watchdog"):
+            for _ in rot.slots():
+                pass
+        dumps = sorted(tmp_path.glob("blit-flight-*.json"))
+        assert len(dumps) == 1
+        doc = json.load(open(dumps[0]))
+        assert "producer stalled" in doc["reason"]
+        assert any(e["name"] == "drill.before-the-trip"
+                   for e in doc["events"])
+
+        assert main(["trace-view", str(dumps[0])]) == 0
+        out = capsys.readouterr().out
+        assert "blit-drill-feed: producer stalled" in out
+        assert "drill.before-the-trip" in out
+        assert "stall watchdog" in out
+
+    def test_breaker_trip_dumps(self, tmp_path, monkeypatch):
+        from blit.config import SiteConfig
+
+        monkeypatch.setenv("BLIT_FLIGHT_DIR", str(tmp_path))
+        monkeypatch.setattr(observability.flight_recorder(),
+                            "min_interval_s", 0.0)
+        faults.install(faults.FaultRule("remote.call", "fail", times=-1))
+        try:
+            pool = WorkerPool(
+                ["h0"], backend="remote", transport=local_transport,
+                agent_env=agent_env_with_repo(),
+                config=SiteConfig(call_retries=0, breaker_threshold=1,
+                                  retry_jitter=0.0),
+            )
+            try:
+                with pytest.raises(Exception):
+                    pool.run_on([1], _touch_process_timeline, [()])
+            finally:
+                pool.shutdown()
+        finally:
+            faults.clear()
+        dumps = list(tmp_path.glob("blit-flight-*.json"))
+        assert dumps, "breaker trip / agent death left no flight dump"
+        reasons = [json.load(open(d))["reason"] for d in dumps]
+        assert any("died" in r or "breaker" in r for r in reasons)
+
+    def test_dump_rate_limited_and_forceable(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("BLIT_FLIGHT_DIR", str(tmp_path))
+        rec = observability.FlightRecorder(min_interval_s=60.0)
+        assert rec.dump("first") is not None
+        assert rec.dump("suppressed") is None
+        assert rec.dump("forced", force=True) is not None
+
+    def test_ring_is_bounded(self):
+        rec = observability.FlightRecorder(capacity=16)
+        for i in range(100):
+            rec.event("fault", f"e{i}")
+        evs = rec.events()
+        assert len(evs) == 16 and evs[-1]["name"] == "e99"
+
+    def test_disable_env(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("BLIT_FLIGHT_DIR", str(tmp_path))
+        monkeypatch.setenv("BLIT_FLIGHT_DISABLE", "1")
+        rec = observability.FlightRecorder(min_interval_s=0.0)
+        assert rec.dump("nope") is None
+        assert not list(tmp_path.glob("*.json"))
+
+    def test_render_flight_dump_tail(self):
+        doc = {"reason": "r", "t": 0, "host": "h", "pid": 1, "worker": 0,
+               "events": [{"t": 0, "kind": "stage", "name": f"e{i}", "s": 1}
+                          for i in range(50)],
+               "faults": {"retry.io": 2}, "timeline": {}}
+        out = render_flight_dump(doc, tail=5)
+        assert "e49" in out and "e40" not in out and "retry.io" in out
+
+
+# -- JSON logging (ISSUE 5 satellite) ---------------------------------------
+
+
+class TestJsonLogging:
+    def test_json_lines_records(self, blit_logger_restored):
+        buf = StringIO()
+        configure_logging(level=logging.INFO, worker=7, json_lines=True,
+                          stream=buf)
+        logging.getLogger("blit.test").info("hello %s", "fleet")
+        logging.getLogger("blit.test").warning("deg raded")
+        lines = [ln for ln in buf.getvalue().splitlines() if ln]
+        assert len(lines) == 2
+        recs = [json.loads(ln) for ln in lines]
+        for rec in recs:
+            assert set(rec) >= {"ts", "level", "host", "worker", "name",
+                                "msg"}
+            assert rec["worker"] == 7
+            assert rec["host"] == observability.hostname()
+        assert recs[0]["msg"] == "hello fleet"
+        assert recs[1]["level"] == "WARNING"
+        # configure_logging(worker=) also stamps span identity.
+        with observability.span("w") as sp:
+            pass
+        assert sp.worker == 7
+        configure_logging(worker=0)  # restore module-global worker id
+
+    def test_worker_startup_threading(self, monkeypatch):
+        """The pool stamps each remote agent's env with its worker id and
+        the driver's BLIT_LOG_JSON flag rides along (agent.main reads
+        both) — worker startup is wired, not just the formatter."""
+        monkeypatch.setenv("BLIT_LOG_JSON", "1")
+        pool = WorkerPool(["x", "y"], backend="remote",
+                          transport=local_transport)
+        try:
+            envs = [w.remote._env for w in pool.workers]
+            assert [e["BLIT_WORKER_ID"] for e in envs] == ["1", "2"]
+            assert all(e.get("BLIT_LOG_JSON") == "1" for e in envs)
+        finally:
+            pool.shutdown()
+
+    def test_ssh_transport_carries_stamp_in_remote_command(self,
+                                                           monkeypatch):
+        """sshd does not forward client env vars: over the production ssh
+        transport the identity stamp must ride the remote command line
+        (`env K=V python3 -m blit.agent`)."""
+        from blit.parallel.remote import ssh_command
+
+        cmd = ssh_command("blc17", remote_env={"BLIT_WORKER_ID": "3"})
+        i = cmd.index("env")
+        assert cmd[i:i + 2] == ["env", "BLIT_WORKER_ID=3"]
+        assert cmd[-3:] == ["python3", "-m", "blit.agent"]
+        # The pool routes the stamp through the transport when it accepts
+        # remote_env (the default ssh_command does).
+        monkeypatch.delenv("BLIT_LOG_JSON", raising=False)
+        seen = {}
+
+        def transport(host, remote_env=None):
+            seen[host] = remote_env
+            return local_agent_command()
+
+        pool = WorkerPool(["hx", "hy"], backend="remote",
+                          transport=transport)
+        try:
+            assert seen == {"hx": {"BLIT_WORKER_ID": "1"},
+                            "hy": {"BLIT_WORKER_ID": "2"}}
+        finally:
+            pool.shutdown()
+
+
+# -- CLI --------------------------------------------------------------------
+
+
+class TestTelemetryCli:
+    def test_demo_json_report_and_trace(self, tmp_path, capsys):
+        from blit.__main__ import main
+
+        trace = str(tmp_path / "trace.json")
+        rc = main(["telemetry", "--demo", "--workers", "2",
+                   "--nfft", "64", "--format", "json",
+                   "--trace-out", trace])
+        assert rc == 0
+        report = json.loads(capsys.readouterr().out)
+        host = observability.hostname()
+        assert host in report["hosts"]
+        assert report["hosts"][host]["stages"]["ingest"]["calls"] >= 2
+        doc = json.load(open(trace))
+        names = {e["name"] for e in doc["traceEvents"]
+                 if e.get("ph") == "X"}
+        assert "telemetry-demo" in names and "reduce.to_file" in names
+
+    def test_prom_exposition(self, capsys):
+        from blit.__main__ import main
+
+        with observability.process_timeline().stage("probe.cli", nbytes=1):
+            pass
+        rc = main(["telemetry", "--format", "prom"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "# TYPE blit_stage_calls_total counter" in out
+        assert 'stage="probe.cli"' in out
+
+    def test_from_file_render(self, tmp_path, capsys):
+        from blit.__main__ import main
+
+        report = merge_fleet([telemetry_snapshot()])
+        p = tmp_path / "report.json"
+        p.write_text(json.dumps(report))
+        assert main(["telemetry", "--from", str(p)]) == 0
+        assert "fleet:" in capsys.readouterr().out
+
+    def test_trace_out_works_without_demo(self, tmp_path, capsys):
+        from blit.__main__ import main
+
+        with observability.span("cli-leg"):
+            pass
+        trace = tmp_path / "t.json"
+        assert main(["telemetry", "--trace-out", str(trace)]) == 0
+        doc = json.loads(trace.read_text())
+        assert any(e.get("name") == "cli-leg" for e in doc["traceEvents"])
+
+
+# -- scheduler histogram satellite ------------------------------------------
+
+
+class TestSchedulerBoundedWaits:
+    def test_wait_percentiles_shape_and_bounded_memory(self):
+        from blit.serve.scheduler import Scheduler
+
+        s = Scheduler(max_concurrency=2)
+        for _ in range(300):
+            s.submit(lambda: None).result(timeout=10)
+        s.close()
+        pct = s.wait_percentiles()
+        assert set(pct) == {"p50", "p99", "n"}  # report shape kept
+        assert pct["n"] == 300
+        assert 0.0 <= pct["p50"] <= pct["p99"]
+        # Bounded: the histogram is 64 counters, not a 300-entry list.
+        assert len(s.wait_hist.counts) == 64
+        assert not hasattr(s, "wait_samples")
+
+
+# -- retry backoff histogram ------------------------------------------------
+
+
+class TestRetryBackoffHistogram:
+    def test_backoff_observes_process_timeline(self):
+        h = observability.process_timeline().hists["retry.backoff_s"]
+        n0 = h.n
+        policy = faults.RetryPolicy(attempts=3, base_s=0.01, jitter=0.0,
+                                    sleep=lambda s: None)
+        policy.backoff(0)
+        policy.backoff(1)
+        assert h.n == n0 + 2
+        assert h.vmax >= 0.01
