@@ -248,6 +248,10 @@ def run_single(config_name: str) -> None:
     except Exception as e:  # noqa: BLE001 — secondary metric must not kill the line
         result["config1_error"] = f"{type(e).__name__}: {e}"
     try:
+        result.update(_run_dedoppler(config_name))
+    except Exception as e:  # noqa: BLE001 — secondary metric must not kill the line
+        result["dedoppler_error"] = f"{type(e).__name__}: {e}"
+    try:
         result.update(_run_collectives())
     except Exception as e:  # noqa: BLE001 — secondary metric must not kill the line
         result["collectives_error"] = f"{type(e).__name__}: {e}"
@@ -851,6 +855,71 @@ def _run_config1() -> dict:
         }
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
+
+
+# Search-plane science leg shapes: (window_spectra T, channels F, reps K).
+# The metric is drift-rate trials/s/chip — (2T-1) drift rows × F channels
+# × K windows scored per second by the on-device tree + SNR + per-band
+# top-k step (blit/ops/pallas_dedoppler), device-resident with a single
+# closing fetch like the primary leg.
+_DEDOPPLER_CONFIGS = {
+    "tpu_bf16": (64, 1 << 20, 8),
+    "tpu": (64, 1 << 20, 8),
+    "tpu_small": (32, 1 << 19, 8),
+    "cpu": (16, 1 << 14, 4),
+}
+
+
+def _run_dedoppler(config_name: str) -> dict:
+    """The search plane's science metric (ISSUE 6 / ROADMAP item 4):
+    sustained drift-rate trials per second of the jitted on-device
+    dedoppler step over synthetic windows with an injected drifting
+    tone (which doubles as a liveness check: the tone must surface as
+    the strongest hit)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from blit.ops.pallas_dedoppler import dedoppler_hits, unpack_hits
+
+    T, F, K = _DEDOPPLER_CONFIGS[config_name]
+    nbands = max(1, F >> 14)  # ~one band per 16k channels
+    rng = np.random.default_rng(3)
+    x = rng.normal(100.0, 10.0, size=(T, F)).astype(np.float32)
+    # A clean drifting tone along the tree's own drift-7 path.
+    from blit.ops.pallas_dedoppler import tree_path_shift
+
+    f0, db = F // 3, min(7, T - 1)
+    for t in range(T):
+        x[t, f0 + tree_path_shift(db, t, T)] += 400.0
+    # dedoppler_hits is module-level jitted (knobs static); binding the
+    # knobs is enough.
+    fn = functools.partial(dedoppler_hits, top_k=4, nbands=nbands,
+                           kernel="auto")
+    xj = jax.block_until_ready(jnp.asarray(x))
+    thr = jnp.float32(8.0)
+    packed = jax.block_until_ready(fn(xj, thr))  # warmup / compile
+    snr, _, drift, chan, _ = unpack_hits(np.asarray(packed))
+    top = int(np.argmax(snr)) if len(snr) else -1
+    t0 = time.perf_counter()
+    acc = [fn(xj, thr) for _ in range(K)]
+    jax.block_until_ready(acc[-1])
+    elapsed = time.perf_counter() - t0
+    trials = (2 * T - 1) * F * K
+    return {
+        "dedoppler_drift_rates_per_s": round(trials / elapsed, 1),
+        "dedoppler_config": {
+            "window_spectra": T,
+            "nchans": F,
+            "nbands": nbands,
+            "calls": K,
+            "seconds": round(elapsed, 3),
+            "tone_recovered": bool(
+                top >= 0 and int(drift[top]) == db and int(chan[top]) == f0
+            ),
+        },
+    }
 
 
 def _probe_backend() -> str:

@@ -25,6 +25,9 @@ Layer map (mirrors SURVEY.md §1, rebuilt TPU-first):
 - ``blit.serve``     — the product service layer: priority scheduler with
   admission control, single-flight request coalescing, two-tier
   content-addressed result cache.
+- ``blit.search``    — the search plane: on-device Taylor-tree
+  drift-rate search (``.hits`` products alongside ``.fil``/``.h5``),
+  windowed feeds + device-side threshold/top-k + ragged async hit sink.
 - ``blit.observability`` — the telemetry plane: spans/tracer with fan-out
   context propagation, stage timelines + log-bucketed histograms, fleet
   telemetry harvest, and the crash/stall flight recorder.
@@ -39,6 +42,8 @@ __all__ = [
     "ProductCache",
     "Scheduler",
     "Overloaded",
+    "DedopplerReducer",
+    "Hit",
 ]
 
 # The serving layer's front-door names re-export from blit.serve (lazily —
@@ -51,12 +56,23 @@ _SERVE_EXPORTS = (
     "Overloaded",
 )
 
+# The search plane's front-door names re-export from blit.search (lazily —
+# the drift kernels pull jax, which `import blit` must not).
+_SEARCH_EXPORTS = (
+    "DedopplerReducer",
+    "Hit",
+)
+
 
 def __getattr__(name):
     if name in _SERVE_EXPORTS:
         import importlib
 
         return getattr(importlib.import_module("blit.serve"), name)
+    if name in _SEARCH_EXPORTS:
+        import importlib
+
+        return getattr(importlib.import_module("blit.search"), name)
     # Lazy submodule access (keeps `import blit` light; JAX-dependent modules
     # only load when touched).
     if name in (
@@ -73,6 +89,7 @@ def __getattr__(name):
         "faults",
         "outplane",
         "serve",
+        "search",
         "observability",
     ):
         import importlib

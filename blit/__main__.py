@@ -7,6 +7,9 @@ the library (:mod:`blit.gbt` et al.) and this thin command layer over it.
 Commands:
   reduce     GUPPI RAW (file, .NNNN.raw sequence stem, or member list)
              → filterbank product (.fil streams to disk; .h5 = FBH5).
+  search     GUPPI RAW → .hits drift-rate search product: the on-device
+             Taylor-tree dedoppler over windowed spectra (ISSUE 6) —
+             only hit records ever cross the readback link.
   scan       Whole (session, scan) across the device mesh: crawl the
              tree, map every player's RAW sequence onto the (band, bank)
              mesh, stream each stitched band to a per-band product —
@@ -67,6 +70,44 @@ def _cmd_reduce(args: argparse.Namespace) -> int:
                 "nifs": hdr.get("nifs"),
                 "input_bytes": stats.input_bytes,
                 "gbps": round(stats.gbps, 3),
+            }
+        )
+    )
+    return 0
+
+
+def _cmd_search(args: argparse.Namespace) -> int:
+    from blit.pipeline import PRODUCT_PRESETS
+    from blit.search import DedopplerReducer
+
+    nfft, nint = ((args.nfft, args.nint) if args.product is None
+                  else PRODUCT_PRESETS[args.product])
+    red = DedopplerReducer(
+        nfft=nfft, nint=nint, dtype=args.dtype,
+        window_spectra=args.window_spectra, top_k=args.top_k,
+        snr_threshold=args.snr, max_drift_bins=args.max_drift_bins,
+        kernel=args.kernel, interpret=args.interpret,
+    )
+    src: object = args.raw[0] if len(args.raw) == 1 else args.raw
+    if args.resume:
+        hdr = red.search_resumable(src, args.output)
+    else:
+        hdr = red.search_to_file(src, args.output)
+    tl = red.timeline.report()
+    print(
+        json.dumps(
+            {
+                "output": args.output,
+                "windows": hdr.get("search_windows"),
+                "hits": hdr.get("search_nhits"),
+                "nchans": hdr.get("nchans"),
+                "window_spectra": hdr.get("search_window_spectra"),
+                "snr_threshold": hdr.get("search_snr_threshold"),
+                "top_k": hdr.get("search_top_k"),
+                # The per-window tree latency / hits-per-window
+                # distributions (sync path populates tree_s; the async
+                # plane's equivalent is out.chunk_latency_s).
+                "hists": tl.get("hists", {}),
             }
         )
     )
@@ -284,6 +325,37 @@ def _cmd_ingest_bench(args: argparse.Namespace) -> int:
             "product_bytes": os.path.getsize(out),
         }
 
+    def run_dedoppler() -> dict:
+        """The science leg (ISSUE 6): the same recording through the
+        search plane — RAW → windowed spectra → on-device Taylor tree →
+        ``.hits`` — reporting drift-rate trials/s alongside the ingest
+        rate (a drift trial = one (drift row, channel) cell scored)."""
+        from blit.search import DedopplerReducer
+
+        red = DedopplerReducer(
+            nfft=args.nfft, nint=args.nint,
+            chunk_frames=args.chunk_frames, dtype=args.dtype,
+            window_spectra=args.dedoppler_window, snr_threshold=5.0,
+        )
+        out = os.path.join(td, "bench.hits")
+        t0 = _time.perf_counter()
+        hdr = red.search_to_file(raw_path, out)
+        wall = _time.perf_counter() - t0
+        T = hdr["search_window_spectra"]
+        windows = hdr.get("search_windows", 0)
+        trials = (2 * T - 1) * hdr["nchans"] * windows
+        tl = red.timeline
+        return {
+            "windows": windows,
+            "window_spectra": T,
+            "hits": hdr.get("search_nhits"),
+            "wall_s": round(wall, 3),
+            "ingest_gbps": round(file_bytes / wall / 1e9, 4),
+            "drift_rates_per_s": round(trials / wall, 1),
+            "hists": tl.report().get("hists", {}),
+            "product_bytes": os.path.getsize(out),
+        }
+
     with tempfile.TemporaryDirectory(prefix="blit-ingest-bench-") as td:
         raw_path = os.path.join(td, "bench.raw")
         # File length leaves exactly the (ntap-1)*nfft PFB tail after the
@@ -304,6 +376,8 @@ def _cmd_ingest_bench(args: argparse.Namespace) -> int:
         if args.sync_compare:
             legs.append(run(False))
         report = {"file_bytes": file_bytes, "legs": legs}
+        if args.dedoppler:
+            report["dedoppler"] = run_dedoppler()
         if len(legs) == 2 and legs[1]["wall_s"] > 0:
             report["async_speedup"] = round(
                 legs[1]["wall_s"] / max(legs[0]["wall_s"], 1e-9), 3
@@ -457,6 +531,44 @@ def main(argv: Optional[List[str]] = None) -> int:
                          ".fil and .h5)")
     pr.set_defaults(fn=_cmd_reduce)
 
+    ph = sub.add_parser(
+        "search",
+        help="RAW → .hits drift-rate search product (on-device dedoppler)",
+    )
+    ph.add_argument("raw", nargs="+",
+                    help="RAW file, .NNNN.raw sequence stem, or member list")
+    ph.add_argument("-o", "--output", required=True,
+                    help="output .hits product path (JSON lines)")
+    ph.add_argument("--product", choices=list(_PRODUCTS),
+                    help="rawspec product preset for the underlying "
+                         "filterbank (else --nfft/--nint)")
+    ph.add_argument("--nfft", type=int, default=1024)
+    ph.add_argument("--nint", type=int, default=1)
+    ph.add_argument("--dtype", default="float32",
+                    choices=["float32", "bfloat16"])
+    ph.add_argument("--window-spectra", type=int, default=None,
+                    help="spectra per drift transform (power of two; "
+                         "default SiteConfig/BLIT_SEARCH_WINDOW)")
+    ph.add_argument("--snr", type=float, default=None,
+                    help="device-side SNR threshold "
+                         "(default SiteConfig/BLIT_SEARCH_SNR)")
+    ph.add_argument("--top-k", type=int, default=None,
+                    help="hits kept per band per window "
+                         "(default SiteConfig/BLIT_SEARCH_TOP_K)")
+    ph.add_argument("--max-drift-bins", type=int, default=None,
+                    help="clamp the searched drift range (bins/window; "
+                         "default the full ±(window-1))")
+    ph.add_argument("--kernel", default="auto",
+                    choices=["auto", "reference", "pallas"],
+                    help="drift-transform backend")
+    ph.add_argument("--interpret", action="store_true",
+                    help="run the pallas kernel in interpreter mode "
+                         "(CPU smoke tests)")
+    ph.add_argument("--resume", action="store_true",
+                    help="crash-resumable search (cursor sidecar; resumes "
+                         "at the last durable window boundary)")
+    ph.set_defaults(fn=_cmd_search)
+
     ps = sub.add_parser(
         "scan", help="whole (session, scan) → per-band products via the mesh"
     )
@@ -534,6 +646,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "and report the tracing overhead ratio")
     pg.add_argument("--spans-reps", type=int, default=3,
                     help="interleaved repetitions per spans-compare arm")
+    pg.add_argument("--dedoppler", action="store_true",
+                    help="also run the drift-search science leg over the "
+                         "same recording and report drift-rate trials/s")
+    pg.add_argument("--dedoppler-window", type=int, default=8,
+                    help="search window (spectra per drift transform, "
+                         "power of two) for the --dedoppler leg")
     pg.set_defaults(fn=_cmd_ingest_bench)
 
     pb = sub.add_parser(

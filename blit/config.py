@@ -8,9 +8,10 @@ API function accepts an optional ``config=`` override (SURVEY.md §5 "Config").
 
 from __future__ import annotations
 
+import os
 import re
 from dataclasses import dataclass
-from typing import List, Optional, Pattern, Tuple
+from typing import Dict, List, Optional, Pattern, Tuple
 
 from blit import naming
 
@@ -104,6 +105,19 @@ class SiteConfig:
     cache_dir: Optional[str] = None
     serve_max_concurrency: int = 4
     serve_queue_depth: int = 64
+    # Search plane (blit/search; ISSUE 6).  search_window_spectra is the
+    # Taylor-tree integration window (spectra per drift transform, power
+    # of two — the drift resolution is one bin per window);
+    # search_top_k bounds the hits extracted per band per window on
+    # device; search_snr_threshold is the device-side SNR cut; and
+    # search_max_drift_bins clamps the searched drift range (None = the
+    # full ±(window-1) bins the tree computes).  Per-process overrides:
+    # BLIT_SEARCH_WINDOW / BLIT_SEARCH_TOP_K / BLIT_SEARCH_SNR /
+    # BLIT_SEARCH_MAX_DRIFT (see :func:`search_defaults`).
+    search_window_spectra: int = 64
+    search_top_k: int = 8
+    search_snr_threshold: float = 10.0
+    search_max_drift_bins: Optional[int] = None
 
     def io_retry_policy(self):
         """The :class:`blit.faults.RetryPolicy` for worker-side file I/O —
@@ -147,6 +161,31 @@ DEFAULT = SiteConfig()
 # (DESIGN.md §3) — scaled to whole frames at other nfft.  Lives here (not
 # blit.parallel.scan) so the CLI can derive it without importing jax.
 WINDOW_SAMPLES = 8 << 20
+
+
+def search_defaults(config: SiteConfig = DEFAULT) -> Dict:
+    """The effective search-plane knob set: ``config``'s values with
+    per-process ``BLIT_SEARCH_*`` environment overrides applied — the
+    faults-layer pattern (``BLIT_IO_RETRIES``) for the search knobs, so
+    a deployment can retune a worker fleet without code changes.
+    Resolved at reducer construction, not import, so tests and drills
+    can flip the env per run."""
+    max_drift = os.environ.get("BLIT_SEARCH_MAX_DRIFT")
+    max_drift = int(max_drift) if max_drift else config.search_max_drift_bins
+    if max_drift is not None and max_drift < 0:
+        # Headers/cursors encode "no limit" as -1 (JSON has no None-safe
+        # int); feeding that back in must mean unlimited again, not a
+        # drift mask that silently rejects every row.
+        max_drift = None
+    return {
+        "window_spectra": int(os.environ.get(
+            "BLIT_SEARCH_WINDOW", config.search_window_spectra)),
+        "top_k": int(os.environ.get(
+            "BLIT_SEARCH_TOP_K", config.search_top_k)),
+        "snr_threshold": float(os.environ.get(
+            "BLIT_SEARCH_SNR", config.search_snr_threshold)),
+        "max_drift_bins": max_drift,
+    }
 
 
 def default_window_frames(nfft: int) -> int:

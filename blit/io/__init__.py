@@ -7,6 +7,7 @@ with optional C++ acceleration from ``blit/native``.
 
 from blit.io.sigproc import read_fil_header, read_fil_data, write_fil
 from blit.io.fbh5 import is_hdf5, read_fbh5_header, read_fbh5_data, write_fbh5
+from blit.io.hits import read_hits, write_hits
 from blit.io.guppi import (
     GuppiRaw,
     GuppiScan,
@@ -24,6 +25,8 @@ __all__ = [
     "read_fbh5_header",
     "read_fbh5_data",
     "write_fbh5",
+    "read_hits",
+    "write_hits",
     "GuppiRaw",
     "GuppiScan",
     "open_raw",

@@ -1,0 +1,215 @@
+"""``.hits`` product codec: atomic-publish, resumable hit-list writers.
+
+The search plane's product is RAGGED — a variable number of hit records
+per time window — so it gets its own line-oriented format instead of a
+fixed-shape slab: JSON lines, first line a header record (``kind``,
+format version, the full search/filterbank header), then one line per
+hit in stream order.  JSON-lines because hit lists are small (the whole
+point of on-device search is that only hits cross the wire), humans
+triage them directly (docs/WORKFLOWS.md), and byte-determinism is easy
+to pin: ``sort_keys=True`` everywhere, floats via the default repr.
+
+Writer contracts mirror the filterbank writers (blit/io/sigproc.py,
+blit/io/fbh5.py) so the async output plane drives them unchanged:
+
+- :class:`HitsWriter` streams into a ``.partial`` sibling renamed on
+  success — a crash never leaves a complete-looking truncated product.
+- :class:`ResumableHitsWriter` appends directly, with a cursor sidecar
+  (:class:`blit.search.dedoppler.SearchCursor`) claiming windows only
+  AFTER their lines are fsync'd — the ResumableFilWriter durability
+  ordering.  ``abort()`` keeps file + cursor as the resume point.
+- Both expose ``append(WindowHits)`` / ``flush`` / ``close`` /
+  ``abort`` / ``nsamps``, and :class:`WindowHits` carries ``nbytes``,
+  so :class:`blit.outplane.AsyncSink` write-behind (bounded queue,
+  flush barriers, ``sink.write`` fault injection) works on hit lists
+  exactly as on spectra slabs — the ragged sink path of ISSUE 6.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+HITS_KIND = "blit.hits"
+HITS_VERSION = 1
+
+
+def _jsonable(header: Dict) -> Dict:
+    import numpy as np
+
+    out = {}
+    for k, v in header.items():
+        if isinstance(v, np.generic):
+            v = v.item()
+        out[k] = v
+    return out
+
+
+def header_line(header: Dict) -> str:
+    """The deterministic first line of a ``.hits`` file."""
+    return json.dumps(
+        {"kind": HITS_KIND, "version": HITS_VERSION,
+         "header": _jsonable(header)},
+        sort_keys=True, default=str,
+    ) + "\n"
+
+
+class WindowHits:
+    """One window's hit list, pre-serialized — the ragged slab the
+    async sink queues (its ``nbytes`` is what the ``write`` stage
+    accounts)."""
+
+    __slots__ = ("window", "hits", "lines")
+
+    def __init__(self, window: int, hits: List) -> None:
+        self.window = window
+        self.hits = hits
+        self.lines = "".join(
+            json.dumps(h.record(), sort_keys=True) + "\n" for h in hits
+        )
+
+    @property
+    def nbytes(self) -> int:
+        return len(self.lines)
+
+
+class HitsWriter:
+    """Streaming ``.hits`` writer with the ``.partial``-rename publish
+    rule (module docstring).  ``nsamps`` counts hits written — the
+    writer-contract name every sink already speaks."""
+
+    def __init__(self, path: str, header: Dict) -> None:
+        self.path = path
+        self._tmp = path + ".partial"
+        self._f = open(self._tmp, "w")
+        self._f.write(header_line(header))
+        self.nsamps = 0
+        self.nwindows = 0
+
+    def append(self, wh: WindowHits) -> None:
+        self._f.write(wh.lines)
+        self.nsamps += len(wh.hits)
+        self.nwindows += 1
+
+    def flush(self) -> None:
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+    def close(self) -> None:
+        self.flush()
+        self._f.close()
+        os.replace(self._tmp, self.path)
+
+    def abort(self) -> None:
+        """Error-path teardown: drop the ``.partial`` (never leave a
+        complete-looking product)."""
+        try:
+            self._f.close()
+        finally:
+            try:
+                os.unlink(self._tmp)
+            except OSError:
+                pass
+
+
+class ResumableHitsWriter:
+    """Append-directly ``.hits`` writer whose incompleteness marker is a
+    cursor sidecar: window lines are fsync'd BEFORE the cursor claims
+    them, so a crash leaves a resumable prefix, never a cursor ahead of
+    the bytes.  ``start_windows`` > 0 resumes — the file is truncated to
+    the cursor's claimed byte offset (dropping any un-checkpointed
+    tail); 0 or a missing file starts fresh."""
+
+    def __init__(self, path: str, header: Dict, start_windows: int,
+                 cursor) -> None:
+        self.path = path
+        self.cursor = cursor
+        if start_windows > 0 and os.path.exists(path):
+            with open(path, "r+b") as f:
+                f.truncate(cursor.byte_offset)
+            cursor.windows_done = start_windows
+            cursor.save(path)
+            self._f = open(path, "a")
+        else:
+            self._f = open(path, "w")
+            self._f.write(header_line(header))
+            self._f.flush()
+            os.fsync(self._f.fileno())
+            cursor.windows_done = 0
+            cursor.hits_done = 0
+            cursor.byte_offset = self._f.tell()
+            cursor.save(path)
+        # Cumulative across the whole product, resumed windows included
+        # (the ResumableFilWriter nsamps = start_rows convention) — the
+        # finished header's search_nhits must count every hit line in
+        # the file, not just this run's.
+        self.nsamps = cursor.hits_done
+        self.nwindows = cursor.windows_done
+
+    def append(self, wh: WindowHits) -> None:
+        self._f.write(wh.lines)
+        # Durable lines BEFORE the cursor claims them (power-loss
+        # ordering, the ResumableFilWriter rule).
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self.nsamps += len(wh.hits)
+        self.nwindows += 1
+        self.cursor.windows_done = self.nwindows
+        self.cursor.hits_done = self.nsamps
+        self.cursor.byte_offset = self._f.tell()
+        self.cursor.save(self.path)
+
+    def flush(self) -> None:
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+    def close(self) -> None:
+        """Finish: the sidecar's absence is the completeness marker."""
+        self._f.close()
+        sidecar = self.cursor.path_for(self.path)
+        if os.path.exists(sidecar):
+            os.unlink(sidecar)
+
+    def abort(self) -> None:
+        # The file + cursor ARE the resume point: keep both.
+        self._f.close()
+
+
+def write_hits(path: str, header: Dict, hits: List) -> None:
+    """One-shot atomic ``.hits`` publish (in-memory hit list)."""
+    w = HitsWriter(path, header)
+    try:
+        w.append(WindowHits(-1, hits))
+    except BaseException:
+        w.abort()
+        raise
+    w.close()
+
+
+def read_hits(path: str) -> Tuple[Dict, List]:
+    """Read a ``.hits`` product → ``(header, hits)`` with hits as
+    :class:`blit.search.hits.Hit` objects (lazy import — blit.io stays
+    light)."""
+    from blit.search.hits import hit_from_record
+
+    header: Optional[Dict] = None
+    hits = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            doc = json.loads(line)
+            if header is None:
+                if doc.get("kind") != HITS_KIND:
+                    raise ValueError(
+                        f"{path}: not a {HITS_KIND} file "
+                        f"(kind={doc.get('kind')!r})"
+                    )
+                header = doc["header"]
+                continue
+            hits.append(hit_from_record(doc))
+    if header is None:
+        raise ValueError(f"{path}: empty .hits file")
+    return header, hits

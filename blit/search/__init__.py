@@ -1,0 +1,33 @@
+"""blit.search — the search plane (ISSUE 6).
+
+On-device Taylor-tree drift-rate search as a first-class product type:
+``.hits`` alongside ``.fil``/``.h5``, computed from the same streaming
+plane (windowed feeds → pallas/lax drift transform → device-side
+threshold + per-band top-k → async ragged hit sink).
+
+- :class:`~blit.search.dedoppler.DedopplerReducer` — the streaming
+  driver (search / search_to_file / search_resumable / reduce).
+- :class:`~blit.search.hits.Hit` + the array/record codecs — the hit
+  product atom and its cache-friendly dense encoding.
+- the kernels live in :mod:`blit.ops.pallas_dedoppler`; the ``.hits``
+  file writers in :mod:`blit.io.hits`.
+"""
+
+from blit.search.dedoppler import DedopplerReducer, SearchCursor
+from blit.search.hits import (
+    Hit,
+    hit_from_record,
+    hits_from_array,
+    hits_from_packed,
+    hits_to_array,
+)
+
+__all__ = [
+    "DedopplerReducer",
+    "SearchCursor",
+    "Hit",
+    "hit_from_record",
+    "hits_from_array",
+    "hits_from_packed",
+    "hits_to_array",
+]

@@ -96,15 +96,22 @@ def reduction_fingerprint(
 
 
 def fingerprint_for(reducer, raw_path: Union[str, Sequence[str]]) -> str:
-    """The fingerprint of ``reducer`` (a :class:`blit.pipeline.RawReducer`)
-    applied to ``raw_path`` — pulls every output-affecting knob off the
-    configured reducer so the two can never drift."""
+    """The fingerprint of ``reducer`` (a :class:`blit.pipeline.RawReducer`
+    or any reducer speaking its knob surface) applied to ``raw_path`` —
+    pulls every output-affecting knob off the configured reducer so the
+    two can never drift.  Reducers with EXTRA output-affecting knobs
+    (e.g. :class:`blit.search.dedoppler.DedopplerReducer`'s drift-search
+    parameters) expose them via a ``fingerprint_extra()`` dict, merged
+    into the key the same way the despike width would be — absent for
+    plain reductions, so existing keys are untouched."""
+    extra_fn = getattr(reducer, "fingerprint_extra", None)
     return reduction_fingerprint(
         raw_path,
         nfft=reducer.nfft, nint=reducer.nint, ntap=reducer.ntap,
         stokes=reducer.stokes, window=reducer.window,
         fqav_by=reducer.fqav_by, dtype=reducer.dtype,
         fft_method=reducer.fft_method,
+        extra=extra_fn() if extra_fn is not None else None,
     )
 
 

@@ -53,7 +53,17 @@ class ProductRequest:
     configure the reduction directly (exactly the
     :func:`blit.workers.reduce_raw` contract).  ``raw`` may be a single
     path or a multi-file sequence member list — member ORDER does not
-    change the request's identity (fingerprints normalize it)."""
+    change the request's identity (fingerprints normalize it).
+
+    ``kind="hits"`` asks for a drift-rate search product instead of a
+    filterbank (ISSUE 6): the reduction runs a
+    :class:`blit.search.dedoppler.DedopplerReducer` and the result array
+    is the dense hit-table encoding
+    (:func:`blit.search.hits.hits_from_array` decodes it under the
+    returned header).  The search knobs join the fingerprint, so cached
+    ``.hits`` and ``.fil`` products of the same recording never collide,
+    and identical concurrent searches single-flight like any other
+    request."""
 
     raw: Union[str, Tuple[str, ...]]
     product: Optional[str] = None
@@ -62,6 +72,13 @@ class ProductRequest:
     stokes: str = "I"
     fqav_by: int = 1
     dtype: str = "float32"
+    # Product kind: "filterbank" (default) | "hits" (drift search).
+    kind: str = "filterbank"
+    # Search knobs (kind="hits" only; None -> SiteConfig/env defaults).
+    window_spectra: Optional[int] = None
+    snr_threshold: Optional[float] = None
+    top_k: Optional[int] = None
+    max_drift_bins: Optional[int] = None
 
     def __post_init__(self):
         if isinstance(self.raw, list):
@@ -70,9 +87,39 @@ class ProductRequest:
             raise ValueError(
                 "pass either product= or explicit nfft/nint, not both"
             )
+        if self.kind not in ("filterbank", "hits"):
+            raise ValueError(f"unknown product kind {self.kind!r}")
+        if self.kind != "hits" and any(
+            v is not None for v in (self.window_spectra, self.snr_threshold,
+                                    self.top_k, self.max_drift_bins)
+        ):
+            raise ValueError("search knobs require kind='hits'")
+        if self.kind == "hits" and (self.stokes != "I" or self.fqav_by != 1):
+            raise ValueError(
+                "hits products search the Stokes-I stream un-averaged "
+                "(stokes='I', fqav_by=1)"
+            )
 
     def reducer(self):
-        """The configured :class:`blit.pipeline.RawReducer` for this ask."""
+        """The configured reducer for this ask: a
+        :class:`blit.pipeline.RawReducer` for filterbanks, a
+        :class:`blit.search.dedoppler.DedopplerReducer` for hits — both
+        expose ``reduce(raw) -> (header, array)`` and the fingerprint
+        knob surface, so the service treats them alike."""
+        if self.kind == "hits":
+            from blit.pipeline import PRODUCT_PRESETS
+            from blit.search import DedopplerReducer
+
+            nfft, nint = (
+                PRODUCT_PRESETS[self.product] if self.product is not None
+                else (self.nfft, self.nint)
+            )
+            return DedopplerReducer(
+                nfft=nfft, nint=nint, dtype=self.dtype,
+                window_spectra=self.window_spectra,
+                snr_threshold=self.snr_threshold, top_k=self.top_k,
+                max_drift_bins=self.max_drift_bins,
+            )
         from blit.pipeline import RawReducer, reducer_for_product
 
         kw = dict(stokes=self.stokes, fqav_by=self.fqav_by, dtype=self.dtype)

@@ -111,6 +111,16 @@ def make_raw_header(
     }
 
 
+def tone_drift_for(nfft: int, nspectra: int, drift_bins: float) -> float:
+    """The ``tone_drift`` (cycles/sample²) that drifts a tone by
+    ``drift_bins`` FINE channels (bin width ``1/nfft`` cycles/sample)
+    over ``nspectra`` consecutive nfft-point spectra — the known-ḟ
+    injection for drift-search recovery tests (ISSUE 6 satellite):
+    inject with this, search with ``window_spectra=nspectra``, and the
+    top hit's ``drift_bins`` must match within one drift step."""
+    return drift_bins / (nfft * nspectra * nfft)
+
+
 def make_voltages(
     obsnchan: int,
     ntime: int,
@@ -120,14 +130,20 @@ def make_voltages(
     tone_freq: float = 0.25,
     tone_amp: float = 20.0,
     noise_rms: float = 8.0,
+    tone_drift: float = 0.0,
 ) -> np.ndarray:
     """Quantized complex voltages (obsnchan, ntime, npol, 2) int8: Gaussian
-    noise plus an optional complex tone in one coarse channel (a drift-free
-    'technosignature' for end-to-end detection tests)."""
+    noise plus an optional complex tone in one coarse channel (a
+    'technosignature' for end-to-end detection tests).  ``tone_drift``
+    chirps the tone linearly — instantaneous frequency
+    ``tone_freq + tone_drift·t`` cycles/sample (phase integrates the
+    chirp: ``2π(f₀·t + ½·ḟ·t²)``); :func:`tone_drift_for` maps a target
+    fine-bin drift to this unit."""
     rng = np.random.default_rng(seed)
     v = rng.normal(0.0, noise_rms, size=(obsnchan, ntime, npol, 2))
     if tone_chan is not None:
-        ph = 2 * np.pi * tone_freq * np.arange(ntime)
+        t = np.arange(ntime, dtype=np.float64)
+        ph = 2 * np.pi * (tone_freq * t + 0.5 * tone_drift * t * t)
         v[tone_chan, :, :, 0] += tone_amp * np.cos(ph)[:, None]
         v[tone_chan, :, :, 1] += tone_amp * np.sin(ph)[:, None]
     return np.clip(np.round(v), -128, 127).astype(np.int8)
@@ -143,15 +159,21 @@ def synth_raw(
     directio: bool = False,
     seed: int = 0,
     tone_chan: Optional[int] = None,
+    tone_drift: float = 0.0,
+    tone_freq: float = 0.25,
+    tone_amp: float = 20.0,
     **hdrkw,
 ) -> Tuple[Dict, List[np.ndarray]]:
     """Write a synthetic GUPPI RAW file.  With ``overlap`` > 0, consecutive
     blocks share their trailing/leading ``overlap`` samples, as on disk at
-    GBT."""
+    GBT.  ``tone_drift`` chirps the injected tone (a drifting
+    technosignature — :func:`tone_drift_for`)."""
     hdr = make_raw_header(obsnchan=obsnchan, npol=npol, overlap=overlap, **hdrkw)
     step = ntime_per_block - overlap
     total = step * (nblocks - 1) + ntime_per_block
-    stream = make_voltages(obsnchan, total, npol, seed=seed, tone_chan=tone_chan)
+    stream = make_voltages(obsnchan, total, npol, seed=seed,
+                           tone_chan=tone_chan, tone_drift=tone_drift,
+                           tone_freq=tone_freq, tone_amp=tone_amp)
     blocks = [stream[:, i * step : i * step + ntime_per_block] for i in range(nblocks)]
     write_raw(path, hdr, blocks, directio=directio)
     return hdr, blocks
@@ -167,6 +189,7 @@ def synth_raw_sequence(
     overlap: int = 0,
     seed: int = 0,
     tone_chan: Optional[int] = None,
+    tone_drift: float = 0.0,
     **hdrkw,
 ) -> Tuple[List[str], np.ndarray]:
     """Write a multi-file ``.NNNN.raw`` scan sequence carrying ONE contiguous
@@ -180,7 +203,8 @@ def synth_raw_sequence(
     hdr = make_raw_header(obsnchan=obsnchan, npol=npol, overlap=overlap, **hdrkw)
     step = ntime_per_block - overlap
     total = step * (nblocks - 1) + ntime_per_block
-    stream = make_voltages(obsnchan, total, npol, seed=seed, tone_chan=tone_chan)
+    stream = make_voltages(obsnchan, total, npol, seed=seed,
+                           tone_chan=tone_chan, tone_drift=tone_drift)
     blocks = [
         stream[:, i * step : i * step + ntime_per_block] for i in range(nblocks)
     ]

@@ -199,3 +199,39 @@ def reduce_raw(
     if resume:
         raise ValueError("reduce_raw: resume=True requires a .fil out_path")
     return red.reduce(raw_path)
+
+
+def search_raw(
+    raw_path,
+    out_path: Optional[str] = None,
+    nfft: int = 1024,
+    nint: int = 1,
+    resume: bool = False,
+    **search_kw,
+):
+    """Drift-search a GUPPI RAW recording on this worker (ISSUE 6) — the
+    search-plane twin of :func:`reduce_raw`, so pools fan drift searches
+    across the hosts that own the files exactly like reductions.
+
+    With ``out_path`` a ``.hits`` product is written (``resume=True``
+    restarts from its cursor sidecar) and the search header returned;
+    without it, ``(header, hit_records)`` come back over the wire —
+    records as plain dicts (:meth:`blit.search.hits.Hit.record`) so the
+    restricted agent transport never needs the Hit class.  ``search_kw``
+    passes the :class:`~blit.search.dedoppler.DedopplerReducer` knobs
+    through (window_spectra / snr_threshold / top_k / max_drift_bins /
+    kernel / ...); unset knobs resolve from SiteConfig + ``BLIT_SEARCH_*``
+    on the WORKER, as deployments expect."""
+    from blit.observability import process_timeline
+    from blit.search import DedopplerReducer
+
+    search_kw.setdefault("timeline", process_timeline())
+    red = DedopplerReducer(nfft=nfft, nint=nint, **search_kw)
+    if out_path is not None:
+        if resume:
+            return red.search_resumable(raw_path, out_path)
+        return red.search_to_file(raw_path, out_path)
+    if resume:
+        raise ValueError("search_raw: resume=True requires an out_path")
+    header, hits = red.search(raw_path)
+    return header, [h.record() for h in hits]

@@ -82,10 +82,34 @@ class TestPublicSurface:
         for name in expected:
             assert callable(getattr(blit.serve, name)), name
 
+    SEARCH_EXPORTS = ("DedopplerReducer", "Hit")
+
+    def test_top_level_reexports_search_plane(self):
+        # The search plane's front door (ISSUE 6 satellite): pinned like
+        # the serve layer's so a refactor that drops it fails loudly.
+        import blit
+        import blit.search
+
+        for name in self.SEARCH_EXPORTS:
+            assert getattr(blit, name) is getattr(blit.search, name), name
+            assert name in blit.__all__
+
+    def test_search_module_surface(self):
+        import blit.search
+
+        expected = {
+            "DedopplerReducer", "SearchCursor", "Hit", "hit_from_record",
+            "hits_from_array", "hits_from_packed", "hits_to_array",
+        }
+        assert set(blit.search.__all__) == expected
+        for name in expected:
+            assert callable(getattr(blit.search, name)), name
+
     def test_serve_package_ships(self):
         with open(os.path.join(REPO, "pyproject.toml"), "rb") as f:
             tool = tomllib.load(f)["tool"]["setuptools"]
         assert "blit.serve" in tool["packages"]
+        assert "blit.search" in tool["packages"]
 
     def test_unknown_attribute_still_raises(self):
         import blit
