@@ -28,6 +28,11 @@ Layer map (mirrors SURVEY.md §1, rebuilt TPU-first):
 - ``blit.search``    — the search plane: on-device Taylor-tree
   drift-rate search (``.hits`` products alongside ``.fil``/``.h5``),
   windowed feeds + device-side threshold/top-k + ragged async hit sink.
+- ``blit.stream``    — the streaming ingest plane: chunk sources
+  (growing-file tailer / paced replay / queue), watermark-based
+  windowing with zero-weight late/missing-chunk masking, and
+  ``stream_reduce``/``stream_search`` live entry points byte-identical
+  to the batch paths.
 - ``blit.observability`` — the telemetry plane: spans/tracer with fan-out
   context propagation, stage timelines + log-bucketed histograms, fleet
   telemetry harvest, and the crash/stall flight recorder.
@@ -44,6 +49,8 @@ __all__ = [
     "Overloaded",
     "DedopplerReducer",
     "Hit",
+    "stream_reduce",
+    "stream_search",
 ]
 
 # The serving layer's front-door names re-export from blit.serve (lazily —
@@ -63,6 +70,13 @@ _SEARCH_EXPORTS = (
     "Hit",
 )
 
+# The streaming ingest plane's front-door names re-export from
+# blit.stream (lazily — the plane pulls the reducers, which pull jax).
+_STREAM_EXPORTS = (
+    "stream_reduce",
+    "stream_search",
+)
+
 
 def __getattr__(name):
     if name in _SERVE_EXPORTS:
@@ -73,6 +87,10 @@ def __getattr__(name):
         import importlib
 
         return getattr(importlib.import_module("blit.search"), name)
+    if name in _STREAM_EXPORTS:
+        import importlib
+
+        return getattr(importlib.import_module("blit.stream"), name)
     # Lazy submodule access (keeps `import blit` light; JAX-dependent modules
     # only load when touched).
     if name in (
@@ -90,6 +108,7 @@ def __getattr__(name):
         "outplane",
         "serve",
         "search",
+        "stream",
         "observability",
     ):
         import importlib

@@ -10,6 +10,13 @@ Commands:
   search     GUPPI RAW → .hits drift-rate search product: the on-device
              Taylor-tree dedoppler over windowed spectra (ISSUE 6) —
              only hit records ever cross the readback link.
+  stream     LIVE reduction (ISSUE 7): follow a RAW file the recorder is
+             still appending to (or replay a completed one at wall-clock
+             / accelerated rate) and produce the .fil/.h5 — or, with
+             --search, .hits — product *during* the session, with
+             watermark lateness masking and p50/p99 chunk→product
+             latency in the report.  Byte-identical to the batch path
+             for a completed stream.
   scan       Whole (session, scan) across the device mesh: crawl the
              tree, map every player's RAW sequence onto the (band, bank)
              mesh, stream each stitched band to a per-band product —
@@ -111,6 +118,66 @@ def _cmd_search(args: argparse.Namespace) -> int:
             }
         )
     )
+    return 0
+
+
+def _cmd_stream(args: argparse.Namespace) -> int:
+    """Live reduction front door (ISSUE 7).  Default mode FOLLOWS the
+    file as the recorder appends (ending at the ``.done`` marker or the
+    idle timeout); ``--replay-rate`` replays a completed recording
+    through the same plane — the latency rig and the byte-identity
+    drill."""
+    from blit.observability import Timeline
+    from blit.pipeline import PRODUCT_PRESETS
+    from blit.stream import FileTailSource, ReplaySource
+
+    if args.replay_rate is not None:
+        src = ReplaySource(args.raw, rate=args.replay_rate)
+    else:
+        src = FileTailSource(args.raw, poll_s=args.poll,
+                             idle_timeout_s=args.idle_timeout,
+                             done_path=args.done_file)
+    nfft, nint = ((args.nfft, args.nint) if args.product is None
+                  else PRODUCT_PRESETS[args.product])
+    tl = Timeline()
+    if args.search:
+        from blit.stream import stream_search
+
+        hdr = stream_search(
+            src, args.output, lateness_s=args.lateness, nfft=nfft,
+            nint=nint, dtype=args.dtype, timeline=tl,
+            window_spectra=args.window_spectra, snr_threshold=args.snr,
+            top_k=args.top_k,
+        )
+        body = {"hits": hdr.get("search_nhits"),
+                "windows": hdr.get("search_windows")}
+    else:
+        from blit.stream import stream_reduce
+
+        hdr = stream_reduce(
+            src, args.output, lateness_s=args.lateness, nfft=nfft,
+            nint=nint, stokes=args.stokes, fqav_by=args.fqav,
+            dtype=args.dtype, compression=args.compression, timeline=tl,
+        )
+        body = {"nsamps": hdr.get("nsamps"), "nchans": hdr.get("nchans")}
+    lat = tl.report().get("hists", {}).get("stream.chunk_to_product_s", {})
+    out = {
+        "output": args.output,
+        **body,
+        "stream_chunks": hdr.get("stream_chunks"),
+        "late_chunks": hdr.get("stream_late_chunks"),
+        "dup_chunks": hdr.get("stream_dup_chunks"),
+        "masked_chunks": hdr.get("stream_masked_chunks"),
+        "degraded_spectra": hdr.get("stream_degraded_spectra",
+                                    hdr.get("stream_degraded_windows")),
+        "chunk_to_product_p50_s": lat.get("p50"),
+        "chunk_to_product_p99_s": lat.get("p99"),
+    }
+    if hdr.get("_masked_chunks"):
+        out["masked_chunk_seqs"] = hdr["_masked_chunks"]
+    if hdr.get("stream_flight_dump"):
+        out["flight_dump"] = hdr["stream_flight_dump"]
+    print(json.dumps(out))
     return 0
 
 
@@ -356,6 +423,62 @@ def _cmd_ingest_bench(args: argparse.Namespace) -> int:
             "product_bytes": os.path.getsize(out),
         }
 
+    def run_live(drill: bool) -> dict:
+        """The live leg (ISSUE 7): replay the recording through the
+        streaming ingest plane at ``--live-rate`` × wall-clock recording
+        rate and report p50/p99 chunk→product latency.  The recording is
+        re-synthesized with TBIN stretched so it SPANS ``--live-seconds``
+        of wall time — replay pacing is meaningless on a microsecond
+        recording.  ``drill=True`` is the seeded late-chunk drill: one
+        chunk held past a tightened lateness budget, proving the product
+        masks (and flight-records) instead of wedging."""
+        from blit.observability import Timeline
+        from blit.stream import ReplaySource, stream_reduce
+
+        nblocks = max(4, args.blocks)
+        ntime = (args.chunks * args.chunk_frames + 3) * args.nfft
+        per_block = -(-ntime // nblocks)
+        live_raw = os.path.join(td, "live.raw")
+        synth_raw(live_raw, nblocks=nblocks, obsnchan=args.nchan,
+                  ntime_per_block=per_block,
+                  tbin=args.live_seconds / (nblocks * per_block))
+        tl = Timeline()
+        red = RawReducer(nfft=args.nfft, nint=args.nint,
+                         chunk_frames=args.chunk_frames, fqav_by=args.fqav,
+                         dtype=args.dtype, timeline=tl)
+        lateness = None
+        late = {}
+        if drill:
+            # Chunk 1 arrives well past a tightened budget: it must be
+            # masked (zero weight) while the stream keeps flowing.
+            lateness = 0.02 * args.live_seconds
+            late = {1: 0.8 * args.live_seconds}
+        src = ReplaySource(live_raw, rate=args.live_rate, late=late)
+        out = os.path.join(td, "live_drill.fil" if drill else "live.fil")
+        t0 = _time.perf_counter()
+        hdr = stream_reduce(src, out, reducer=red, lateness_s=lateness)
+        wall = _time.perf_counter() - t0
+        lat = tl.report().get("hists", {}).get(
+            "stream.chunk_to_product_s", {})
+        leg = {
+            "rate": args.live_rate,
+            "recording_s": round(args.live_seconds, 3),
+            "wall_s": round(wall, 3),
+            "chunks": hdr["stream_chunks"],
+            "chunk_to_product_p50_s": lat.get("p50"),
+            "chunk_to_product_p99_s": lat.get("p99"),
+            "late_chunks": hdr["stream_late_chunks"],
+            "dup_chunks": hdr["stream_dup_chunks"],
+            "masked_chunks": hdr["stream_masked_chunks"],
+            # Output spectra whose PFB windows touched a zero-filled
+            # sample — the clean path must report 0 here.
+            "degraded_spectra": hdr["stream_degraded_spectra"],
+            "product_bytes": os.path.getsize(out),
+        }
+        if hdr.get("stream_flight_dump"):
+            leg["flight_dump"] = hdr["stream_flight_dump"]
+        return leg
+
     with tempfile.TemporaryDirectory(prefix="blit-ingest-bench-") as td:
         raw_path = os.path.join(td, "bench.raw")
         # File length leaves exactly the (ntap-1)*nfft PFB tail after the
@@ -378,6 +501,10 @@ def _cmd_ingest_bench(args: argparse.Namespace) -> int:
         report = {"file_bytes": file_bytes, "legs": legs}
         if args.dedoppler:
             report["dedoppler"] = run_dedoppler()
+        if args.live:
+            report["live"] = run_live(False)
+        if args.live_drill:
+            report["live_drill"] = run_live(True)
         if len(legs) == 2 and legs[1]["wall_s"] > 0:
             report["async_speedup"] = round(
                 legs[1]["wall_s"] / max(legs[0]["wall_s"], 1e-9), 3
@@ -569,6 +696,59 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "at the last durable window boundary)")
     ph.set_defaults(fn=_cmd_search)
 
+    pl = sub.add_parser(
+        "stream",
+        help="LIVE reduction: follow (or replay) a recording and write "
+             "the product during the session (ISSUE 7)",
+    )
+    pl.add_argument("raw",
+                    help="RAW file (or growing .NNNN.raw member) to "
+                         "follow, or the completed recording to replay")
+    pl.add_argument("-o", "--output", required=True,
+                    help="product path: .fil / .h5, or .hits with "
+                         "--search")
+    pl.add_argument("--product", choices=list(_PRODUCTS),
+                    help="rawspec product preset (else --nfft/--nint)")
+    pl.add_argument("--nfft", type=int, default=1024)
+    pl.add_argument("--nint", type=int, default=1)
+    pl.add_argument("--stokes", default="I")
+    pl.add_argument("--fqav", type=int, default=1)
+    pl.add_argument("--dtype", default="float32",
+                    choices=["float32", "bfloat16"])
+    pl.add_argument("--compression", default=None,
+                    choices=["gzip", "bitshuffle"],
+                    help="codec for .h5 (FBH5) output")
+    pl.add_argument("--search", action="store_true",
+                    help="write a .hits drift-search product instead of "
+                         "a filterbank")
+    pl.add_argument("--window-spectra", type=int, default=None,
+                    help="search window (with --search; default "
+                         "SiteConfig/BLIT_SEARCH_WINDOW)")
+    pl.add_argument("--snr", type=float, default=None,
+                    help="search SNR threshold (with --search)")
+    pl.add_argument("--top-k", type=int, default=None,
+                    help="hits kept per band per window (with --search)")
+    pl.add_argument("--replay-rate", type=float, default=None,
+                    help="replay a COMPLETED recording at this multiple "
+                         "of wall-clock recording rate instead of "
+                         "tailing a growing one (1.0 = real time)")
+    pl.add_argument("--lateness", type=float, default=None,
+                    help="watermark allowed-lateness budget in seconds "
+                         "(default SiteConfig/BLIT_STREAM_LATENESS); "
+                         "chunks missing past it are masked to zero "
+                         "weight, stragglers dropped")
+    pl.add_argument("--poll", type=float, default=None,
+                    help="growing-file poll cadence in seconds "
+                         "(default SiteConfig/BLIT_STREAM_POLL)")
+    pl.add_argument("--idle-timeout", type=float, default=None,
+                    help="end the tail after this long without file "
+                         "growth (default SiteConfig/"
+                         "BLIT_STREAM_IDLE_TIMEOUT: wait forever)")
+    pl.add_argument("--done-file", default=None,
+                    help="end-of-session marker path (default "
+                         "<stem>.done)")
+    pl.set_defaults(fn=_cmd_stream)
+
     ps = sub.add_parser(
         "scan", help="whole (session, scan) → per-band products via the mesh"
     )
@@ -652,6 +832,22 @@ def main(argv: Optional[List[str]] = None) -> int:
     pg.add_argument("--dedoppler-window", type=int, default=8,
                     help="search window (spectra per drift transform, "
                          "power of two) for the --dedoppler leg")
+    pg.add_argument("--live", action="store_true",
+                    help="also replay the recording through the "
+                         "streaming ingest plane at --live-rate and "
+                         "report p50/p99 chunk→product latency "
+                         "(ISSUE 7)")
+    pg.add_argument("--live-rate", type=float, default=1.0,
+                    help="replay speed as a multiple of wall-clock "
+                         "recording rate (1.0 = real time)")
+    pg.add_argument("--live-seconds", type=float, default=0.5,
+                    help="wall-clock span the live recording is "
+                         "stretched to cover (TBIN-scaled)")
+    pg.add_argument("--live-drill", action="store_true",
+                    help="also run the seeded late-chunk drill: one "
+                         "chunk past a tightened lateness budget must "
+                         "yield a masked (not wedged) product and a "
+                         "flight-recorder dump")
     pg.set_defaults(fn=_cmd_ingest_bench)
 
     pb = sub.add_parser(

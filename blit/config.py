@@ -118,6 +118,23 @@ class SiteConfig:
     search_top_k: int = 8
     search_snr_threshold: float = 10.0
     search_max_drift_bins: Optional[int] = None
+    # Streaming ingest plane (blit/stream; ISSUE 7).  stream_lateness_s is
+    # the watermark's allowed-lateness budget: a missing chunk is masked
+    # (zero weight, the PR 2 antenna discipline) once the watermark —
+    # newest arrival + this budget — passes it, and a chunk arriving
+    # after its seat was masked is counted late and dropped.
+    # stream_poll_s is the growing-file tailer's poll cadence;
+    # stream_idle_timeout_s ends a tailed session when the recorder
+    # neither grows the file nor writes the done marker for that long
+    # (None = wait for the marker forever); stream_stall_timeout_s arms
+    # the live feed's producer-progress watchdog (flight-dump + raise
+    # instead of a silent wedge; None = unarmed).  Per-process overrides:
+    # BLIT_STREAM_LATENESS / BLIT_STREAM_POLL / BLIT_STREAM_IDLE_TIMEOUT /
+    # BLIT_STREAM_STALL_TIMEOUT (see :func:`stream_defaults`).
+    stream_lateness_s: float = 2.0
+    stream_poll_s: float = 0.05
+    stream_idle_timeout_s: Optional[float] = None
+    stream_stall_timeout_s: Optional[float] = None
 
     def io_retry_policy(self):
         """The :class:`blit.faults.RetryPolicy` for worker-side file I/O —
@@ -185,6 +202,35 @@ def search_defaults(config: SiteConfig = DEFAULT) -> Dict:
         "snr_threshold": float(os.environ.get(
             "BLIT_SEARCH_SNR", config.search_snr_threshold)),
         "max_drift_bins": max_drift,
+    }
+
+
+def stream_defaults(config: SiteConfig = DEFAULT) -> Dict:
+    """The effective streaming-ingest knob set: ``config``'s values with
+    per-process ``BLIT_STREAM_*`` environment overrides applied (the
+    :func:`search_defaults` pattern) — resolved at stream construction,
+    not import, so drills and deployments retune per run."""
+
+    def opt_s(env: str, fallback: Optional[float]) -> Optional[float]:
+        v = os.environ.get(env)
+        if v is None:
+            return fallback
+        # "" / "none" / negative all mean "unarmed" (the -1 encoding of
+        # the search knobs: JSON/env have no None-safe float).
+        if not v or v.lower() == "none":
+            return None
+        f = float(v)
+        return None if f < 0 else f
+
+    return {
+        "lateness_s": float(os.environ.get(
+            "BLIT_STREAM_LATENESS", config.stream_lateness_s)),
+        "poll_s": float(os.environ.get(
+            "BLIT_STREAM_POLL", config.stream_poll_s)),
+        "idle_timeout_s": opt_s(
+            "BLIT_STREAM_IDLE_TIMEOUT", config.stream_idle_timeout_s),
+        "stall_timeout_s": opt_s(
+            "BLIT_STREAM_STALL_TIMEOUT", config.stream_stall_timeout_s),
     }
 
 

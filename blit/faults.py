@@ -22,8 +22,12 @@ module holds both halves:
   ``fail`` (raise
   :class:`InjectedFault` — an ``OSError``, so retry paths treat it like
   a flaky NFS read), ``delay`` (injectable sleep), ``truncate`` (short
-  read — a *hard* failure the degraded-antenna masking handles) and
-  ``corrupt`` (bit-flip the delivered frame).  Rules fire on exact hit
+  read — a *hard* failure the degraded-antenna masking handles),
+  ``corrupt`` (bit-flip the delivered frame), and — for the streaming
+  ingest plane's ``stream.chunk`` point (blit/stream; ISSUE 7) —
+  ``drop`` (the chunk never arrives: the watermark masks it after the
+  lateness budget) and ``dup`` (the chunk is delivered twice: the
+  assembler drops the duplicate).  Rules fire on exact hit
   counts (``after``/``times``), so a test can target "window 3 of
   antenna 2" and get the same failure every run.  ``BLIT_FAULTS`` in
   the environment arms rules at import time for CLI-level drills (see
@@ -58,7 +62,7 @@ from typing import Callable, Dict, List, Optional
 
 log = logging.getLogger("blit.faults")
 
-MODES = ("fail", "delay", "truncate", "corrupt")
+MODES = ("fail", "delay", "truncate", "corrupt", "drop", "dup")
 
 
 class InjectedFault(OSError):

@@ -753,6 +753,67 @@ def flight_recorder() -> FlightRecorder:
     return _FLIGHT
 
 
+class StallWatchdog:
+    """The one producer-progress stall discipline behind every threaded
+    plane (ISSUE 7 satellite: this used to be four near-identical poll
+    loops).  A thread that owns real progress calls :meth:`beat`;
+    back-pressure waits count as progress (the waiter is the slow side
+    there, not the producer).  The poll side sizes its waits with
+    :meth:`poll_s` and calls :meth:`check` on every empty poll — when no
+    beat landed for ``timeout_s`` while the watched thread is still
+    ``active``, the flight recorder dumps the incident trail (BEFORE the
+    raise unwinds and teardown noise overwrites the ring) and a
+    ``RuntimeError`` bounds the hang.  ``timeout_s=None`` disarms
+    (checks are no-ops; polls use their base interval).
+
+    Users: :class:`blit.pipeline.BufferRotation` (ingest producer),
+    :class:`blit.outplane.OutputRotation` (readback thread),
+    :class:`blit.outplane.AsyncSink` (writer thread, append and flush
+    sides), and the streaming chunk feed
+    (:class:`blit.stream.LiveRawStream`)."""
+
+    def __init__(self, timeout_s: Optional[float], name: str,
+                 what: str = "a wedged producer would otherwise hang"):
+        self.timeout_s = timeout_s
+        self.name = name
+        self.what = what
+        self._beat = time.monotonic()
+
+    def beat(self) -> None:
+        """Mark producer progress (cheap; called from the owning thread —
+        concurrent float stores are atomic in CPython)."""
+        self._beat = time.monotonic()
+
+    def poll_s(self, base: float = 0.2) -> float:
+        """The poll interval a waiter should use: ``base`` unarmed, else
+        clamped so the stall fires within ~half a timeout of reality."""
+        if self.timeout_s is None:
+            return base
+        return min(base, max(0.05, self.timeout_s / 2))
+
+    def stalled(self, active: bool = True) -> bool:
+        return (
+            self.timeout_s is not None
+            and active
+            and time.monotonic() - self._beat > self.timeout_s
+        )
+
+    def trip(self, detail: str) -> None:
+        """Dump the incident and raise (call sites that already know
+        they stalled)."""
+        msg = (
+            f"{self.name}: {detail} — no progress for > "
+            f"{self.timeout_s}s (stall watchdog; {self.what})"
+        )
+        flight_recorder().dump(msg)
+        raise RuntimeError(msg)
+
+    def check(self, detail: str, active: bool = True) -> None:
+        """Raise via :meth:`trip` iff stalled; no-op otherwise."""
+        if self.stalled(active):
+            self.trip(detail)
+
+
 def render_flight_dump(doc: Dict, tail: int = 40) -> str:
     """A flight-recorder dump as a readable incident summary (the
     ``python -m blit trace-view`` body): what tripped, where, the fault

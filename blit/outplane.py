@@ -138,7 +138,10 @@ class OutputRotation:
         self._stop = threading.Event()
         self._free: List[np.ndarray] = []  # released ring slabs (reuse)
         self._nslabs = 0
-        self._beat = time.monotonic()
+        self._wd = observability.StallWatchdog(
+            stall_timeout_s, name,
+            what="a wedged device fetch would otherwise hang the stream",
+        )
         # Captured at construction (the consumer's thread): the readback
         # thread's lifetime span parents onto whatever driver span built
         # the rotation, keeping the output plane causally linked in a
@@ -177,7 +180,7 @@ class OutputRotation:
                 # long dispatches wait before the readback thread reaches
                 # them — the leading indicator of a saturating D2H link.
                 self._tl.observe("out.readback_lag_s", t_got - t_enq)
-                self._beat = time.monotonic()
+                self._wd.beat()
                 # The wait on the dispatch IS the device stage: overlapped
                 # with the consumer thread's next dispatch and the ingest
                 # producer's next read.
@@ -190,7 +193,7 @@ class OutputRotation:
                 if on_consumed is not None:
                     # Output ready ⇒ inputs consumed: ingest slots refill.
                     on_consumed()
-                self._beat = time.monotonic()
+                self._wd.beat()
                 recycled = False
                 with self._tl.stage("readback"):
                     host = np.asarray(out)
@@ -215,7 +218,7 @@ class OutputRotation:
                 # Drop the device reference NOW — HBM frees as soon as the
                 # host copy exists, not when the product hits disk.
                 del out, item
-                self._beat = time.monotonic()
+                self._wd.beat()
                 release = (
                     (lambda s=host: self._release_slab(s))
                     if recycled else None
@@ -257,7 +260,7 @@ class OutputRotation:
                     break
                 if self._stop.is_set():
                     return None
-                self._beat = time.monotonic()
+                self._wd.beat()
                 self._cv.wait(timeout=0.2)
         return np.empty(alloc_shape, dtype)
 
@@ -268,9 +271,7 @@ class OutputRotation:
 
     # -- consumer side -----------------------------------------------------
     def _poll(self) -> float:
-        if self.stall_timeout_s is not None:
-            return min(0.2, max(0.05, self.stall_timeout_s / 2))
-        return 0.2
+        return self._wd.poll_s(0.2)
 
     def _check(self) -> None:
         """Raise under ``self._cv``: forwarded readback error or stall.
@@ -278,19 +279,9 @@ class OutputRotation:
         raise must not see the rotation as healthy afterwards."""
         if self._exc is not None:
             raise self._exc
-        if (
-            self.stall_timeout_s is not None
-            and self._thread.is_alive()
-            and self._pending > 0
-            and time.monotonic() - self._beat > self.stall_timeout_s
-        ):
-            msg = (
-                f"{self._thread.name}: readback stalled — no progress for "
-                f"> {self.stall_timeout_s}s (stall watchdog; a wedged "
-                "device fetch would otherwise hang the stream)"
-            )
-            observability.flight_recorder().dump(msg)
-            raise RuntimeError(msg)
+        if self._pending > 0:
+            self._wd.check("readback stalled",
+                           active=self._thread.is_alive())
 
     def put(self, out, *, nbytes: Optional[int] = None, payload=None,
             on_consumed: Optional[Callable[[], None]] = None
@@ -409,7 +400,10 @@ class AsyncSink:
         self._exc: Optional[BaseException] = None
         self._stopped = False
         self._stop_ev = threading.Event()
-        self._beat = time.monotonic()
+        self._wd = observability.StallWatchdog(
+            stall_timeout_s, name,
+            what="a wedged disk append would otherwise hang the plane",
+        )
         self._span_ctx = observability.tracer().context()
         self._thread = threading.Thread(
             target=self._run, name=name, daemon=True
@@ -436,7 +430,7 @@ class AsyncSink:
                 continue
             if item is _SINK_STOP:
                 return
-            self._beat = time.monotonic()
+            self._wd.beat()
             if isinstance(item, _FlushBarrier):
                 if self._exc is None:
                     try:
@@ -464,7 +458,7 @@ class AsyncSink:
             # consumer reaches its next append() and sees the error.
             if release is not None:
                 release()
-            self._beat = time.monotonic()
+            self._wd.beat()
 
     # -- consumer side -----------------------------------------------------
     def _check(self) -> None:
@@ -474,27 +468,15 @@ class AsyncSink:
             raise self._exc
 
     def _put(self, item) -> None:
-        poll = 0.2
-        if self.stall_timeout_s is not None:
-            poll = min(poll, max(0.05, self.stall_timeout_s / 2))
+        poll = self._wd.poll_s(0.2)
         while True:
             try:
                 self._q.put(item, timeout=poll)
                 return
             except queue.Full:
                 self._check()
-                if (
-                    self.stall_timeout_s is not None
-                    and self._thread.is_alive()
-                    and time.monotonic() - self._beat > self.stall_timeout_s
-                ):
-                    msg = (
-                        f"{self._thread.name}: writer stalled — no progress "
-                        f"for > {self.stall_timeout_s}s (stall watchdog; a "
-                        "wedged disk append would otherwise hang the plane)"
-                    )
-                    observability.flight_recorder().dump(msg)
-                    raise RuntimeError(msg)
+                self._wd.check("writer stalled",
+                               active=self._thread.is_alive())
 
     def append(self, slab: np.ndarray,
                release: Optional[Callable[[], None]] = None) -> None:
@@ -513,21 +495,10 @@ class AsyncSink:
         self._check()
         barrier = _FlushBarrier()
         self._put(barrier)
-        poll = 0.5
-        if self.stall_timeout_s is not None:
-            poll = min(poll, max(0.05, self.stall_timeout_s / 2))
+        poll = self._wd.poll_s(0.5)
         while not barrier.event.wait(timeout=poll):
-            if (
-                self.stall_timeout_s is not None
-                and self._thread.is_alive()
-                and time.monotonic() - self._beat > self.stall_timeout_s
-            ):
-                msg = (
-                    f"{self._thread.name}: writer stalled inside flush "
-                    f"barrier (> {self.stall_timeout_s}s without progress)"
-                )
-                observability.flight_recorder().dump(msg)
-                raise RuntimeError(msg)
+            self._wd.check("writer stalled inside flush barrier",
+                           active=self._thread.is_alive())
             if not self._thread.is_alive():
                 break  # died without recording? _check below decides
         self._check()

@@ -429,6 +429,32 @@ class Window:
             rot.release(self._slot)
 
 
+def record_mask(masked: set, ident, reason: str, *, header: Dict,
+                timeline: Timeline, kind: str = "antenna") -> bool:
+    """The one zero-weight mask bookkeeping rule (ISSUE 2 tentpole,
+    shared): add ``ident`` to ``masked``, mirror the sorted set into the
+    product header (``_masked_<kind>s``), bump the ``<kind>.masked``
+    timeline counter and the process-wide ``mask.<kind>`` fault counter,
+    and log the degradation — so a degraded run SAYS so everywhere a
+    healthy one reports.  Used by the windowed antenna/correlator feeds
+    (``kind="antenna"``) and the streaming ingest plane's watermark
+    masking (``kind="chunk"``, blit/stream — a missing chunk zero-fills
+    exactly like a zero-weighted antenna plane: it contributes nothing
+    to any linear product downstream).  Returns True when ``ident`` was
+    newly masked."""
+    if ident in masked:
+        return False
+    masked.add(ident)
+    header[f"_masked_{kind}s"] = sorted(masked)
+    timeline.count(f"{kind}.masked")
+    faults.incr(f"mask.{kind}")
+    log.warning(
+        "%s %s %s; masking it (zero weight) and continuing degraded",
+        kind, ident, reason,
+    )
+    return True
+
+
 class _DegradedContinuation:
     """Shared degraded-antenna state for the windowed streams (ISSUE 2
     tentpole): with ``on_antenna_error="mask"`` a HARD mid-stream antenna
@@ -458,16 +484,11 @@ class _DegradedContinuation:
         self.masked_antennas: set = set()
 
     def _mask(self, a: int, err: BaseException) -> None:
-        if a not in self.masked_antennas:
-            self.masked_antennas.add(a)
-            self.header["_masked_antennas"] = sorted(self.masked_antennas)
-            self.timeline.count("antenna.masked")
-            faults.incr("mask.antenna")
-            log.warning(
-                "antenna %d hard-failed mid-stream (%s: %s); masking it "
-                "(zero weight) and continuing degraded",
-                a, type(err).__name__, err,
-            )
+        record_mask(
+            self.masked_antennas, a,
+            f"hard-failed mid-stream ({type(err).__name__}: {err})",
+            header=self.header, timeline=self.timeline, kind="antenna",
+        )
 
 
 class AntennaStream(_DegradedContinuation):

@@ -31,6 +31,7 @@ Design:
 
 from __future__ import annotations
 
+import functools
 import logging
 import os
 import queue
@@ -145,7 +146,10 @@ class BufferRotation:
         # from the readback thread while the consumer thread increments.
         self._held = 0
         self._held_lock = threading.Lock()
-        self._beat = time.monotonic()  # last producer progress
+        self._wd = observability.StallWatchdog(
+            stall_timeout_s, name,
+            what="a wedged read would otherwise hang the stream",
+        )
 
     def _run(self) -> None:
         try:
@@ -162,14 +166,14 @@ class BufferRotation:
                 slot = self._free.get(timeout=0.2)
             except queue.Empty:
                 # Back-pressure from the consumer is not a producer stall.
-                self._beat = time.monotonic()
+                self._wd.beat()
                 continue
-            self._beat = time.monotonic()
+            self._wd.beat()
             return slot
         return None
 
     def emit(self, slot: int, payload) -> None:
-        self._beat = time.monotonic()
+        self._wd.beat()
         self._filled.put((slot, payload))
 
     # -- consumer side ----------------------------------------------------
@@ -183,12 +187,10 @@ class BufferRotation:
         on first use; re-raises producer exceptions.  A consumer that holds
         every slot unreleased while asking for more gets a loud error, not
         a silent deadlock (the producer can never fill another slot)."""
-        self._beat = time.monotonic()
+        self._wd.beat()
         self._thread.start()
         self._started = True
-        poll = 0.5
-        if self.stall_timeout_s is not None:
-            poll = min(poll, max(0.05, self.stall_timeout_s / 2))
+        poll = self._wd.poll_s(0.5)
         try:
             while True:
                 try:
@@ -203,24 +205,11 @@ class BufferRotation:
                         )
                         observability.flight_recorder().dump(msg)
                         raise RuntimeError(msg)
-                    if (
-                        self.stall_timeout_s is not None
-                        and self._thread.is_alive()
-                        and time.monotonic() - self._beat
-                        > self.stall_timeout_s
-                    ):
-                        msg = (
-                            f"{self._thread.name}: producer stalled — no "
-                            f"progress for > {self.stall_timeout_s}s "
-                            "(stall watchdog; a wedged read would "
-                            "otherwise hang the stream)"
-                        )
-                        # The incident trail — recent span/stage/fault
-                        # events — is dumped BEFORE the raise unwinds and
-                        # teardown noise overwrites the ring (ISSUE 5
-                        # tentpole #4).
-                        observability.flight_recorder().dump(msg)
-                        raise RuntimeError(msg)
+                    # The watchdog dumps the incident trail BEFORE the
+                    # raise unwinds and teardown noise overwrites the
+                    # flight-recorder ring (ISSUE 5 tentpole #4).
+                    self._wd.check("producer stalled",
+                                   active=self._thread.is_alive())
                     continue
                 if item is None:
                     return
@@ -248,6 +237,19 @@ class BufferRotation:
                     "abandoning the daemon thread", self._thread.name,
                     join_timeout_s,
                 )
+
+
+def raw_block_feed(raw: GuppiRaw):
+    """The at-rest block feed over an indexed block stream: ``(header,
+    kept_samples, read_into)`` triples in stream order — the batch-side
+    producer input of :meth:`RawReducer._fill_rotation`.  A live source
+    provides the same triples through ``feed_blocks()``
+    (blit/stream/plane.py), which is the whole batch≡stream byte-identity
+    contract: both paths feed the identical sample stream through the
+    identical framing."""
+    for i in range(raw.nblocks):
+        yield (raw.header(i), raw.block_ntime_kept(i),
+               functools.partial(raw.read_block_into, i))
 
 
 @dataclass
@@ -511,14 +513,39 @@ class RawReducer:
         bufs: List[Optional[np.ndarray]],
         rot: BufferRotation,
     ) -> None:
-        """Fill the chunk-buffer rotation from the file (producer thread,
-        the :class:`BufferRotation` fill callback).
+        """Fill the chunk-buffer rotation (producer thread, the
+        :class:`BufferRotation` fill callback).
+
+        The block sequence comes either from the at-rest file
+        (:func:`raw_block_feed` over an indexed :class:`GuppiRaw` /
+        :class:`GuppiScan`) or, when the source exposes ``feed_blocks()``,
+        from a live stream still being recorded (the watermark-ordered
+        feed of :class:`blit.stream.LiveRawStream`) — the chunk framing,
+        filter-state carry and flush rule below are shared, which is what
+        makes a streamed reduction byte-identical to the batch path.
+        """
+        feed = (raw.feed_blocks() if hasattr(raw, "feed_blocks")
+                else raw_block_feed(raw))
+        self._fill_rotation(feed, skip_frames, bufs, rot)
+
+    def _fill_rotation(
+        self,
+        feed,
+        skip_frames: int,
+        bufs: List[Optional[np.ndarray]],
+        rot: BufferRotation,
+    ) -> None:
+        """The shared rotation-filling core: consume ``(header,
+        kept_samples, read_into)`` triples in stream order and emit
+        fixed-shape device chunks.
 
         Buffer ``j``'s first ``(ntap-1)*nfft`` samples are the filter state,
         copied from the previously filled buffer's tail (which the consumer
         may still be reading — concurrent reads are fine; a buffer is only
         *refilled* after its consumer released it).  Everything else is read
-        from disk exactly once, directly into place.
+        from the source exactly once, directly into place
+        (``read_into(dst, t0, take)`` copies samples ``[t0, t0+take)`` of
+        the block into ``dst[:, :take]``).
         """
         nfft, ntap, nint = self.nfft, self.ntap, self.nint
         chunk_samps = (self.chunk_frames + ntap - 1) * nfft
@@ -529,9 +556,7 @@ class RawReducer:
         cur: Optional[int] = None
         prev: Optional[int] = None
         filled = 0
-        for i in range(raw.nblocks):
-            hdr = raw.header(i)
-            nt = raw.block_ntime_kept(i)
+        for hdr, nt, read_into in feed:
             if to_skip >= nt:
                 to_skip -= nt
                 continue
@@ -571,9 +596,7 @@ class RawReducer:
                 with self.timeline.stage(
                     "ingest", nbytes=nchan * take * npol * 2
                 ):
-                    raw.read_block_into(
-                        i, bufs[cur][:, filled:], t0=t0, ntime_keep=take
-                    )
+                    read_into(bufs[cur][:, filled:], t0, take)
                 filled += take
                 t0 += take
                 nt -= take

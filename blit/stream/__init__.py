@@ -1,0 +1,37 @@
+"""``blit.stream`` — the streaming ingest plane (ISSUE 7): reduce while
+the telescope records.
+
+Everything upstream of here assumes GUPPI RAW at rest; this package
+feeds the SAME reducers from sources still being written — a growing
+file the recorder appends to, a paced replay, an in-memory queue — with
+watermark-based windowing, late/duplicate/missing-chunk repair (missing
+chunks mask to zero weight, the PR 2 antenna discipline), and bounded
+chunk→product latency as a first-class metric.  ``blit stream`` is the
+CLI; ``ingest-bench --live`` is the latency rig.
+
+The golden contract: streaming a fully-recorded file through
+:func:`stream_reduce` / :func:`stream_search` produces BYTE-IDENTICAL
+``.fil``/``.h5``/``.hits`` products to the batch path.
+"""
+
+from blit.stream.plane import LiveRawStream, stream_reduce, stream_search
+from blit.stream.source import (
+    ChunkSource,
+    FileTailSource,
+    QueueSource,
+    ReplaySource,
+    StreamChunk,
+    chunks_of,
+)
+
+__all__ = [
+    "ChunkSource",
+    "FileTailSource",
+    "LiveRawStream",
+    "QueueSource",
+    "ReplaySource",
+    "StreamChunk",
+    "chunks_of",
+    "stream_reduce",
+    "stream_search",
+]

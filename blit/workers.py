@@ -201,6 +201,50 @@ def reduce_raw(
     return red.reduce(raw_path)
 
 
+def stream_raw(
+    raw_path: str,
+    out_path: str,
+    search: bool = False,
+    replay_rate: Optional[float] = None,
+    lateness_s: Optional[float] = None,
+    idle_timeout_s: Optional[float] = None,
+    done_path: Optional[str] = None,
+    nfft: int = 1024,
+    nint: int = 1,
+    **reducer_kw,
+):
+    """LIVE-reduce a recording still being written on this worker
+    (ISSUE 7) — the streaming twin of :func:`reduce_raw` /
+    :func:`search_raw`: the host that owns the growing file tails it
+    locally (``blit.stream.FileTailSource``) and only the finished
+    product header crosses the wire, so a pool can fan a whole live
+    session across its recorder nodes.
+
+    ``replay_rate`` switches to a paced replay of an at-rest recording
+    (``blit.stream.ReplaySource`` — drills and the bench rig);
+    ``search=True`` writes a ``.hits`` drift-search product through
+    :func:`blit.stream.stream_search` instead of a filterbank.  The
+    watermark knobs left ``None`` resolve from SiteConfig +
+    ``BLIT_STREAM_*`` on the WORKER, as deployments expect."""
+    from blit.observability import process_timeline
+    from blit.stream import (
+        FileTailSource,
+        ReplaySource,
+        stream_reduce,
+        stream_search,
+    )
+
+    if replay_rate is not None:
+        src = ReplaySource(raw_path, rate=replay_rate)
+    else:
+        src = FileTailSource(raw_path, idle_timeout_s=idle_timeout_s,
+                             done_path=done_path)
+    reducer_kw.setdefault("timeline", process_timeline())
+    fn = stream_search if search else stream_reduce
+    return fn(src, out_path, lateness_s=lateness_s, nfft=nfft,
+              nint=nint, **reducer_kw)
+
+
 def search_raw(
     raw_path,
     out_path: Optional[str] = None,

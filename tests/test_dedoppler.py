@@ -419,6 +419,94 @@ class TestDedopplerReducer:
             _reducer().search(str(p))
 
 
+class TestSearchCursorDrills:
+    """SearchCursor edge cases that landed untested in PR 6 (ISSUE 7
+    satellite): the fsync-before-claim crash replay — bytes beyond the
+    cursor's claim are truncated and re-reduced identically — and the
+    truncate-beyond-EOF boundary, mirroring the ReductionCursor resume
+    drills (tests/test_resume_fbh5.py)."""
+
+    def _interrupted(self, tmp_path, claimed_windows=1):
+        """A reference product plus an 'interrupted' resumable twin with
+        ``claimed_windows`` durably claimed, returning
+        ``(raw, ref_path, out_path, per_window_hits)``."""
+        from blit.io.guppi import open_raw
+        from blit.pipeline import ReductionCursor
+
+        raw = tmp_path / "r.raw"
+        _synth(raw, windows=3, tone_chan=0)
+        ref = str(tmp_path / "ref.hits")
+        _reducer().search_to_file(str(raw), ref)
+        out = str(tmp_path / "res.hits")
+        red = _reducer()
+        hdr = red.header_for(open_raw(str(raw)))
+        stream = red._search_stream(open_raw(str(raw)), hdr)
+        per_window = []
+        for _ in range(3):
+            per_window.append(next(stream)[1])
+        stream.close()
+        size, mtime = ReductionCursor.stat_raw(str(raw))
+        cur = SearchCursor(
+            str(raw), NFFT, 4, 1, window_spectra=T, top_k=4,
+            snr_threshold=2.0, raw_size=size, raw_mtime_ns=mtime)
+        w = ResumableHitsWriter(out, hdr, 0, cur)
+        for k in range(claimed_windows):
+            w.append(WindowHits(k, per_window[k]))
+        w.abort()
+        return raw, ref, out, per_window
+
+    def test_unclaimed_tail_truncated_and_replayed(self, tmp_path):
+        # Crash AFTER window 1's lines hit the file but BEFORE the
+        # cursor claimed them (the fsync-before-claim ordering's only
+        # legal torn state): resume must truncate the unclaimed tail
+        # and replay it, finishing byte-identical.
+        raw, ref, out, per_window = self._interrupted(tmp_path)
+        with open(out, "a") as f:
+            f.write(WindowHits(1, per_window[1]).lines)
+        hdr = _reducer().search_resumable(str(raw), out)
+        assert hdr["search_windows"] == 3
+        with open(ref, "rb") as fr, open(out, "rb") as fo:
+            assert fr.read() == fo.read()
+        assert not os.path.exists(SearchCursor.path_for(out))
+
+    def test_torn_line_tail_truncated(self, tmp_path):
+        # A crash mid-write leaves half a JSON line past the claim:
+        # resume truncates it rather than splicing garbage mid-product.
+        raw, ref, out, per_window = self._interrupted(tmp_path)
+        with open(out, "a") as f:
+            f.write(WindowHits(1, per_window[1]).lines[:17])
+        hdr = _reducer().search_resumable(str(raw), out)
+        assert hdr["search_windows"] == 3
+        with open(ref, "rb") as fr, open(out, "rb") as fo:
+            assert fr.read() == fo.read()
+
+    def test_cursor_claim_exactly_at_eof_resumes(self, tmp_path):
+        # The truncate-beyond-EOF guard is a strict inequality: a claim
+        # equal to the file length is the CLEAN crash state and must
+        # resume (not start fresh).
+        raw, ref, out, _ = self._interrupted(tmp_path)
+        cur = SearchCursor.load(out)
+        assert cur.byte_offset == os.path.getsize(out)
+        assert cur.windows_done == 1
+        hdr = _reducer().search_resumable(str(raw), out)
+        assert hdr["search_windows"] == 3
+        # Resumed, not restarted: window 0 was not re-searched.
+        with open(ref, "rb") as fr, open(out, "rb") as fo:
+            assert fr.read() == fo.read()
+
+    def test_cursor_one_byte_past_eof_starts_fresh(self, tmp_path):
+        # One byte past EOF is already corrupt: POSIX truncate would
+        # EXTEND a NUL hole into the product — must start fresh.
+        raw, ref, out, _ = self._interrupted(tmp_path)
+        cur = SearchCursor.load(out)
+        cur.byte_offset = os.path.getsize(out) + 1
+        cur.save(out)
+        hdr = _reducer().search_resumable(str(raw), out)
+        assert hdr["search_windows"] == 3
+        with open(ref, "rb") as fr, open(out, "rb") as fo:
+            assert fr.read() == fo.read()
+
+
 class TestServiceHits:
     def test_hits_product_through_service_and_cache(self, tmp_path):
         from blit.serve import ProductRequest, ProductService

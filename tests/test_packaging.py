@@ -84,6 +84,31 @@ class TestPublicSurface:
 
     SEARCH_EXPORTS = ("DedopplerReducer", "Hit")
 
+    STREAM_EXPORTS = ("stream_reduce", "stream_search")
+
+    def test_top_level_reexports_stream_plane(self):
+        # The streaming ingest plane's front door (ISSUE 7): pinned like
+        # the serve/search layers' so a refactor that drops it fails
+        # loudly.
+        import blit
+        import blit.stream
+
+        for name in self.STREAM_EXPORTS:
+            assert getattr(blit, name) is getattr(blit.stream, name), name
+            assert name in blit.__all__
+
+    def test_stream_module_surface(self):
+        import blit.stream
+
+        expected = {
+            "ChunkSource", "FileTailSource", "LiveRawStream",
+            "QueueSource", "ReplaySource", "StreamChunk", "chunks_of",
+            "stream_reduce", "stream_search",
+        }
+        assert set(blit.stream.__all__) == expected
+        for name in expected:
+            assert callable(getattr(blit.stream, name)), name
+
     def test_top_level_reexports_search_plane(self):
         # The search plane's front door (ISSUE 6 satellite): pinned like
         # the serve layer's so a refactor that drops it fails loudly.
@@ -110,6 +135,7 @@ class TestPublicSurface:
             tool = tomllib.load(f)["tool"]["setuptools"]
         assert "blit.serve" in tool["packages"]
         assert "blit.search" in tool["packages"]
+        assert "blit.stream" in tool["packages"]
 
     def test_unknown_attribute_still_raises(self):
         import blit
