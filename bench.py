@@ -274,6 +274,18 @@ def run_single(config_name: str) -> None:
     print(json.dumps(result))
 
 
+def _staging_stats() -> dict:
+    """The process staging pool's hit counters (blit/hostmem.py) — a
+    reuse rate near zero on a long run means the pool budget is too
+    small for the product shape."""
+    try:
+        from blit import hostmem
+
+        return hostmem.slab_pool().stats()
+    except Exception as e:  # noqa: BLE001 — provenance must not kill the line
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def _run_ingest(config_name: str) -> dict:
     """File→product throughput: synthetic RAW on a ram-backed dir, streamed
     through :class:`blit.pipeline.RawReducer` (native threaded reads + ring
@@ -283,6 +295,7 @@ def _run_ingest(config_name: str) -> dict:
     import tempfile
 
     from blit.io.guppi import GuppiRaw, write_raw
+    from blit.outplane import INGEST_HISTS
     from blit.pipeline import RawReducer
     from blit.testing import make_raw_header
 
@@ -361,17 +374,22 @@ def _run_ingest(config_name: str) -> dict:
         # ~1 = serialized — the BENCH_r05 collapse — higher = hidden).
         product = {}
         try:
-            redp = RawReducer(nfft=nfft, nint=1, stokes="I",
-                              chunk_frames=chunk_frames, dtype=dtype,
-                              fqav_by=16)
-            t2 = time.perf_counter()
-            redp.reduce_to_file(raw, os.path.join(tmp, "bench.0000.fil"))
-            elp = time.perf_counter() - t2
-            product = {
-                "rig_product_gbps": round(file_bytes / elp / 1e9, 3),
-                "product_config": {
-                    "fqav_by": 16,
-                    "sink": ".fil (async output plane)",
+            def product_leg(async_output: bool, name: str) -> dict:
+                # tune_online=False: with BLIT_TUNE_ONLINE=1 the async
+                # leg could persist a profile mid-bench that the sync
+                # leg then loads — the A/B must compare ONE knob set
+                # (same reason ingest-bench pins it).
+                redp = RawReducer(nfft=nfft, nint=1, stokes="I",
+                                  chunk_frames=chunk_frames, dtype=dtype,
+                                  fqav_by=16, async_output=async_output,
+                                  tune_online=False)
+                t2 = time.perf_counter()
+                redp.reduce_to_file(raw, os.path.join(tmp, name))
+                elp = time.perf_counter() - t2
+                return {
+                    "async_output": async_output,
+                    "wall_s": round(elp, 3),
+                    "gbps": round(file_bytes / elp / 1e9, 3),
                     "overlap_efficiency": round(
                         redp.timeline.overlap_efficiency(), 3
                     ),
@@ -379,6 +397,33 @@ def _run_ingest(config_name: str) -> dict:
                         k: {"s": round(v.seconds, 3), "bytes": v.bytes}
                         for k, v in redp.timeline.stages.items()
                     },
+                    # Stage TAILS from the telemetry hists (ISSUE 8):
+                    # p50/p99 readback lag / write / chunk service.
+                    "stage_quantiles": redp.timeline.hist_quantiles(
+                        INGEST_HISTS),
+                }
+
+            # Before/after --sync-compare table ON the bench artifact
+            # (ISSUE 8 acceptance): the same recording through the async
+            # plane and the serialized path, byte-identity checked.
+            pa = product_leg(True, "bench.0000.fil")
+            ps = product_leg(False, "bench.sync.0000.fil")
+            from blit.testing import sync_compare_verdict
+
+            product = {
+                "rig_product_gbps": pa["gbps"],
+                "product_config": {
+                    "fqav_by": 16,
+                    "sink": ".fil (async output plane)",
+                    "overlap_efficiency": pa["overlap_efficiency"],
+                    "stages": pa["stages"],
+                    "stage_quantiles": pa["stage_quantiles"],
+                    "sync_compare": ps,
+                    **sync_compare_verdict(
+                        os.path.join(tmp, "bench.0000.fil"),
+                        os.path.join(tmp, "bench.sync.0000.fil"),
+                        async_wall_s=pa["wall_s"],
+                        sync_wall_s=ps["wall_s"]),
                 },
             }
         except Exception as e:  # noqa: BLE001 — secondary leg must not kill the line
@@ -404,6 +449,11 @@ def _run_ingest(config_name: str) -> dict:
                 "native_reader": raw.native,
                 "sink": "device (see DESIGN.md §8)",
                 "rig_readback_gbps": round(readback_gbps, 4),
+                # Which ingest knobs ran and where they came from
+                # (explicit bench pin / per-rig tuning profile / default
+                # — blit/tune.py; ISSUE 8 satellite).
+                "tuning": red.tuning_provenance(),
+                "staging_pool": _staging_stats(),
                 "stages": {
                     k: {"s": round(v.seconds, 3), "bytes": v.bytes}
                     for k, v in red.timeline.stages.items()

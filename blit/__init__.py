@@ -36,6 +36,13 @@ Layer map (mirrors SURVEY.md §1, rebuilt TPU-first):
 - ``blit.observability`` — the telemetry plane: spans/tracer with fan-out
   context propagation, stage timelines + log-bucketed histograms, fleet
   telemetry harvest, and the crash/stall flight recorder.
+- ``blit.tune``      — the ingest autotuner: per-rig content-addressed
+  tuning profiles (chunk_frames / prefetch_depth / out_depth) converged
+  offline (``blit tune``) or online during the first windows of a
+  reduction, loaded automatically by every reducer.
+- ``blit.hostmem``   — pinned host staging: page-aligned slab allocation
+  and the process-wide staging pool behind the chunk rotations and
+  readback rings.
 """
 
 from blit.version import __version__
@@ -110,6 +117,8 @@ def __getattr__(name):
         "search",
         "stream",
         "observability",
+        "tune",
+        "hostmem",
     ):
         import importlib
 

@@ -34,6 +34,11 @@ Commands:
              write stages and the overlap-efficiency gauge, optionally
              A/B'd against the synchronous path (and against spans
              disabled, for the tracing-overhead bound).
+  tune       Offline ingest autotune (ISSUE 8): sweep the ingest knobs
+             (chunk_frames / prefetch_depth / out_depth) with real timed
+             reductions on THIS rig and persist the winner as a
+             content-addressed per-rig tuning profile that reduce /
+             scan / serve / stream load automatically.
   telemetry  Fleet telemetry (ISSUE 5): harvest per-worker Timelines,
              fault counters and spans into one per-host report (text /
              Prometheus exposition / JSON), render a saved report, or
@@ -189,9 +194,51 @@ def _cmd_scan(args: argparse.Namespace) -> int:
 
     invs = [get_inventory(args.file_re or r"\.raw$", root=args.root)]
     # The EFFECTIVE window (library default + nint rounding), so the
-    # stats line reports what actually executed.
-    wf = (default_window_frames(args.nfft) if args.window_frames is None
-          else args.window_frames)
+    # stats line reports what actually executed.  An unset --window-frames
+    # consults this rig's tuning profile first (blit/tune.py): the scan's
+    # frames-per-dispatch is the same quantity `blit tune` converged as
+    # chunk_frames, so the profile transfers.
+    tuning = {"source": "explicit"}
+    if args.window_frames is None:
+        # Resolve through a throwaway probe reducer so the profile key
+        # comes out of EXACTLY the code path reduce/serve/stream use —
+        # a scan flag can never silently diverge from the fingerprint
+        # (the probe supplies RawReducer's own defaults for every knob
+        # scan doesn't expose).
+        from blit.pipeline import RawReducer
+
+        probe = RawReducer(nfft=args.nfft, nint=args.nint,
+                           stokes=args.stokes, fqav_by=args.fqav,
+                           dtype=args.dtype)
+        probe_prov = probe.tuning_provenance()
+        if probe_prov["sources"]["chunk_frames"] == "profile":
+            wf = probe.chunk_frames
+            prov = probe_prov["profile"]
+            prov["profile_source"] = prov.pop("source")
+            tuning = {"source": "profile", **prov}
+            # The profile's chunk_frames was converged on the REDUCE
+            # path, whose per-dispatch overhead is lighter than scan's
+            # per-window mesh stitch + readback sync — a profile far
+            # below scan's own default shrinks windows enough to let
+            # that overhead dominate.  Keep the profile (the operator
+            # tuned this rig) but say so, loudly and in the stats line.
+            default_wf = default_window_frames(args.nfft)
+            if wf * 16 < default_wf:
+                import logging
+
+                tuning["window_vs_default"] = {"window_frames": wf,
+                                               "default": default_wf}
+                logging.getLogger("blit.scan").warning(
+                    "tuning profile sets window_frames=%d, far below the "
+                    "scan default of %d for nfft=%d; if per-window "
+                    "overhead dominates, pass --window-frames explicitly "
+                    "or re-run `blit tune` at scan-scale chunk_frames",
+                    wf, default_wf, args.nfft)
+        else:
+            wf = default_window_frames(args.nfft)
+            tuning = {"source": "default"}
+    else:
+        wf = args.window_frames
     wf = max((wf // args.nint) * args.nint, args.nint)
     tl = Timeline()
     written = reduce_scan_mesh_to_files(
@@ -226,7 +273,8 @@ def _cmd_scan(args: argparse.Namespace) -> int:
             )
         )
     # Per-stage throughput (read/device/readback/write), like blit reduce.
-    print(json.dumps({"window_frames": wf, "stages": tl.report()}))
+    print(json.dumps({"window_frames": wf, "tuning": tuning,
+                      "stages": tl.report()}))
     return 0
 
 
@@ -361,6 +409,8 @@ def _cmd_ingest_bench(args: argparse.Namespace) -> int:
     import tempfile
     import time as _time
 
+    from blit.outplane import INGEST_HISTS
+
     from blit.pipeline import RawReducer
     from blit.testing import synth_raw
 
@@ -368,7 +418,8 @@ def _cmd_ingest_bench(args: argparse.Namespace) -> int:
         red = RawReducer(nfft=args.nfft, nint=args.nint,
                          chunk_frames=args.chunk_frames,
                          fqav_by=args.fqav, dtype=args.dtype,
-                         async_output=async_output)
+                         nbits=args.nbits, quant_scale=args.quant_scale,
+                         async_output=async_output, tune_online=False)
         out = os.path.join(td, "bench_async.fil" if async_output
                            else "bench_sync.fil")
         t0 = _time.perf_counter()
@@ -385,6 +436,10 @@ def _cmd_ingest_bench(args: argparse.Namespace) -> int:
                     "bytes": v.bytes}
                 for k, v in sorted(list(tl.stages.items()))
             },
+            # Stage TAILS from the telemetry hists (ISSUE 8 satellite):
+            # readback lag / per-append write / per-chunk service
+            # latency p50/p99 — the burst an average hides.
+            "stage_quantiles": tl.hist_quantiles(INGEST_HISTS),
             # Per-chunk latency distributions (out.chunk_latency_s /
             # out.readback_lag_s — ISSUE 5): the tails behind the stage
             # sums above.
@@ -445,7 +500,7 @@ def _cmd_ingest_bench(args: argparse.Namespace) -> int:
         tl = Timeline()
         red = RawReducer(nfft=args.nfft, nint=args.nint,
                          chunk_frames=args.chunk_frames, fqav_by=args.fqav,
-                         dtype=args.dtype, timeline=tl)
+                         dtype=args.dtype, timeline=tl, tune_online=False)
         lateness = None
         late = {}
         if drill:
@@ -479,6 +534,22 @@ def _cmd_ingest_bench(args: argparse.Namespace) -> int:
             leg["flight_dump"] = hdr["stream_flight_dump"]
         return leg
 
+    # --chunk-frames 0 (or negative) = auto: resolve from this rig's
+    # tuning profile (blit/tune.py) exactly as `blit reduce` would; the
+    # probe's provenance is embedded in the report's ingest_config.
+    if args.chunk_frames is not None and args.chunk_frames <= 0:
+        args.chunk_frames = None
+    # tune_online=False throughout the bench: a converged OnlineTuner
+    # persisting mid-run (warmup is exactly its warmup window) would
+    # reshape later legs' knobs AFTER this probe resolved the published
+    # provenance — the A/B legs and ingest_config must describe ONE
+    # knob set, like _cmd_tune's measured sweeps.
+    probe = RawReducer(nfft=args.nfft, nint=args.nint,
+                       chunk_frames=args.chunk_frames, fqav_by=args.fqav,
+                       dtype=args.dtype, nbits=args.nbits,
+                       tune_online=False)
+    args.chunk_frames = probe.chunk_frames
+
     with tempfile.TemporaryDirectory(prefix="blit-ingest-bench-") as td:
         raw_path = os.path.join(td, "bench.raw")
         # File length leaves exactly the (ntap-1)*nfft PFB tail after the
@@ -493,12 +564,27 @@ def _cmd_ingest_bench(args: argparse.Namespace) -> int:
         # streaming, not the one-off jit compile.
         RawReducer(nfft=args.nfft, nint=args.nint,
                    chunk_frames=args.chunk_frames, fqav_by=args.fqav,
-                   dtype=args.dtype).reduce_to_file(
+                   dtype=args.dtype, nbits=args.nbits,
+                   quant_scale=args.quant_scale,
+                   tune_online=False).reduce_to_file(
             raw_path, os.path.join(td, "warmup.fil"))
         legs = [run(True)]
         if args.sync_compare:
             legs.append(run(False))
-        report = {"file_bytes": file_bytes, "legs": legs}
+        report = {
+            "file_bytes": file_bytes,
+            # The knob set every leg ran, with tuning provenance (ISSUE 8
+            # satellite: the BENCH table names the profile behind it).
+            "ingest_config": {
+                "nfft": args.nfft, "nint": args.nint, "nchan": args.nchan,
+                "chunk_frames": args.chunk_frames,
+                "prefetch_depth": probe.prefetch_depth,
+                "out_depth": probe.out_depth, "dtype": args.dtype,
+                "nbits": args.nbits,
+                "tuning": probe.tuning_provenance(),
+            },
+            "legs": legs,
+        }
         if args.dedoppler:
             report["dedoppler"] = run_dedoppler()
         if args.live:
@@ -506,9 +592,13 @@ def _cmd_ingest_bench(args: argparse.Namespace) -> int:
         if args.live_drill:
             report["live_drill"] = run_live(True)
         if len(legs) == 2 and legs[1]["wall_s"] > 0:
-            report["async_speedup"] = round(
-                legs[1]["wall_s"] / max(legs[0]["wall_s"], 1e-9), 3
-            )
+            from blit.testing import sync_compare_verdict
+
+            report.update(sync_compare_verdict(
+                os.path.join(td, "bench_async.fil"),
+                os.path.join(td, "bench_sync.fil"),
+                async_wall_s=legs[0]["wall_s"],
+                sync_wall_s=legs[1]["wall_s"]))
         if args.spans_compare:
             # Tracing-overhead A/B (ISSUE 5 acceptance: always-on spans
             # must cost <= 1%): interleave spans-on/spans-off legs so slow
@@ -531,6 +621,114 @@ def _cmd_ingest_bench(args: argparse.Namespace) -> int:
             report["spans_off_s"] = off
             report["span_overhead"] = round(on / max(off, 1e-9) - 1.0, 4)
         print(json.dumps(report))
+    return 0
+
+
+def _cmd_tune(args: argparse.Namespace) -> int:
+    """Offline ingest autotune (ISSUE 8 tentpole): coordinate descent
+    over ``chunk_frames`` / ``prefetch_depth`` / ``out_depth`` with real
+    timed file→product reductions on THIS rig, persisting the winner as
+    a content-addressed per-rig tuning profile
+    (:mod:`blit.tune`) that every subsequent ``reduce`` / ``scan`` /
+    ``serve`` / ``stream`` with unset knobs loads automatically.  Note
+    each new ``chunk_frames`` candidate costs one XLA compile — tuning
+    is an offline, once-per-rig operation by design."""
+    import os
+    import tempfile
+
+    from blit.outplane import INGEST_HISTS
+    import time as _time
+
+    from blit import tune as T
+    from blit.pipeline import RawReducer
+    from blit.testing import synth_raw
+
+    def build(knobs: dict, **kw) -> "RawReducer":
+        return RawReducer(
+            nfft=args.nfft, nint=args.nint, fqav_by=args.fqav,
+            dtype=args.dtype, nbits=args.nbits,
+            chunk_frames=knobs["chunk_frames"],
+            prefetch_depth=knobs["prefetch_depth"],
+            out_depth=knobs["out_depth"], tune_online=False, **kw,
+        )
+
+    with tempfile.TemporaryDirectory(prefix="blit-tune-") as td:
+        if args.raw:
+            raw_path = args.raw
+            file_bytes = os.path.getsize(raw_path)
+            from blit.io.guppi import open_raw
+
+            rdr = open_raw(raw_path)
+            tuned_nchan = int(rdr.header(0)["OBSNCHAN"])
+            total_samps = sum(rdr.block_ntime_kept(i)
+                              for i in range(rdr.nblocks))
+        else:
+            raw_path = os.path.join(td, "tune.raw")
+            ntime = (args.chunks * args.chunk_frames + 3) * args.nfft
+            _, blocks = synth_raw(raw_path, nblocks=args.blocks,
+                                  obsnchan=args.nchan,
+                                  ntime_per_block=-(-ntime // args.blocks))
+            file_bytes = sum(b.nbytes for b in blocks)
+            tuned_nchan = args.nchan
+            total_samps = sum(b.shape[1] for b in blocks)
+        # Candidates must keep >=2 full chunks inside the recording:
+        # a chunk spanning most of the file measures a degenerate
+        # near-zero-overhead run that always wins and then missizes
+        # every real reduction on the rig.
+        max_cf = max(args.nint, total_samps // args.nfft // 2)
+        # Normalize FIRST so the untimed warmup (jit compile + page
+        # faults) runs at the exact knob set tune() measures first — a
+        # recording-clamped base must not pay its compile inside the
+        # first timed trial (that would understate baseline_gbps).
+        base = T.normalize_base({"chunk_frames": args.chunk_frames},
+                                nint=args.nint, max_chunk_frames=max_cf)
+        build(base).reduce_to_file(raw_path, os.path.join(td, "warm.fil"))
+        seq = [0]
+
+        def measure(knobs: dict) -> float:
+            best = 0.0
+            for _ in range(max(1, args.reps)):
+                red = build(knobs)
+                out = os.path.join(td, f"t{seq[0]}.fil")
+                seq[0] += 1
+                t0 = _time.perf_counter()
+                red.reduce_to_file(raw_path, out)
+                best = max(best,
+                           file_bytes / (_time.perf_counter() - t0) / 1e9)
+                os.unlink(out)
+            return best
+
+        best, trials = T.tune(measure, base=base, nint=args.nint,
+                              max_trials=args.trials,
+                              max_chunk_frames=max_cf)
+        # One confirmation pass at the winner captures the stage tails
+        # that travel with the profile as provenance.
+        winner = build(best)
+        t0 = _time.perf_counter()
+        winner.reduce_to_file(raw_path, os.path.join(td, "winner.fil"))
+        score = file_bytes / (_time.perf_counter() - t0) / 1e9
+        key, ident = T.rig_fingerprint(**winner._tune_fingerprint_kw())
+        prof = T.TuningProfile(
+            key=key, rig=ident, source="offline",
+            tuned_nchan=tuned_nchan,
+            score_gbps=round(score, 4), trials=len(trials),
+            stages=winner.timeline.hist_quantiles(INGEST_HISTS),
+            **{k: int(best[k]) for k in T.KNOBS},
+        )
+        path = T.save_profile(prof)
+        # trials[0] IS the base measurement (tune() scores its — possibly
+        # recording-size-clamped — starting point first), so the baseline
+        # survives even when the requested chunk_frames was capped.
+        base_score = trials[0]["score"] if trials else None
+        print(json.dumps({
+            "profile": path,
+            "key": key,
+            "winner": prof.knobs(),
+            "score_gbps": prof.score_gbps,
+            "baseline_gbps": (round(base_score, 4)
+                              if base_score is not None else None),
+            "trials": trials,
+        }))
     return 0
 
 
@@ -818,6 +1016,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "product crossing the readback link)")
     pg.add_argument("--dtype", default="float32",
                     choices=["float32", "bfloat16"])
+    pg.add_argument("--nbits", type=int, default=32, choices=[8, 16, 32],
+                    help="SIGPROC product quantization: nbits<32 products "
+                         "are narrowed ON DEVICE before D2H (4x/2x fewer "
+                         "bytes across the readback link; byte-identical "
+                         "to the sync path's host quantization)")
+    pg.add_argument("--quant-scale", type=float, default=1.0,
+                    help="affine quantize scale for --nbits 8/16")
     pg.add_argument("--sync-compare", action="store_true",
                     help="also run the fully synchronous output path and "
                          "report the async speedup")
@@ -849,6 +1054,36 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "yield a masked (not wedged) product and a "
                          "flight-recorder dump")
     pg.set_defaults(fn=_cmd_ingest_bench)
+
+    pn = sub.add_parser(
+        "tune",
+        help="autotune the ingest knobs on THIS rig and persist the "
+             "winner as the per-rig tuning profile (ISSUE 8)",
+    )
+    pn.add_argument("--raw", default=None,
+                    help="tune against this real recording instead of a "
+                         "synthetic one")
+    pn.add_argument("--nfft", type=int, default=1024)
+    pn.add_argument("--nint", type=int, default=1)
+    pn.add_argument("--nchan", type=int, default=4,
+                    help="synthetic recording coarse channels")
+    pn.add_argument("--chunk-frames", type=int, default=8,
+                    help="sweep starting point (and synthetic sizing)")
+    pn.add_argument("--chunks", type=int, default=8,
+                    help="device chunks in the synthetic recording")
+    pn.add_argument("--blocks", type=int, default=4,
+                    help="RAW blocks the synthetic recording is split into")
+    pn.add_argument("--fqav", type=int, default=1)
+    pn.add_argument("--dtype", default="float32",
+                    choices=["float32", "bfloat16"])
+    pn.add_argument("--nbits", type=int, default=32, choices=[8, 16, 32])
+    pn.add_argument("--trials", type=int, default=12,
+                    help="measurement budget (each new chunk_frames "
+                         "candidate costs one compile)")
+    pn.add_argument("--reps", type=int, default=1,
+                    help="repetitions per measurement (best-of; raise on "
+                         "noisy rigs)")
+    pn.set_defaults(fn=_cmd_tune)
 
     pb = sub.add_parser(
         "serve-bench",

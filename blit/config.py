@@ -135,6 +135,13 @@ class SiteConfig:
     stream_poll_s: float = 0.05
     stream_idle_timeout_s: Optional[float] = None
     stream_stall_timeout_s: Optional[float] = None
+    # Ingest performance plane (blit/tune.py + blit/hostmem.py; ISSUE 8).
+    # tune_dir overrides where per-rig tuning profiles live (None = the
+    # BLIT_TUNE_DIR env, else ~/.cache/blit/tune); staging_pool_bytes is
+    # the process-wide staging-slab pool budget (env BLIT_STAGING_BYTES
+    # wins; 0 disables pooling).
+    tune_dir: Optional[str] = None
+    staging_pool_bytes: Optional[int] = None
 
     def io_retry_policy(self):
         """The :class:`blit.faults.RetryPolicy` for worker-side file I/O —

@@ -1,0 +1,187 @@
+"""Pinned, persistent host staging memory (ISSUE 8 tentpole b).
+
+Every leg of the ingest plane stages bytes through big host buffers: the
+chunk rotation's int8 voltage buffers (blit/pipeline.py), the output
+plane's readback ring slabs (blit/outplane.py), and the collective
+feeds' window planes.  Before this module each stream allocated its
+buffers fresh — GB-sized ``np.empty`` calls whose first-touch page
+faults land INSIDE the timed stream (BENCH_r05's ingest leg measured
+the fault storm, not the disk) and whose pages are cold again for the
+next reduction the serve layer runs.  The staging pool makes host
+buffers rig-persistent:
+
+- :func:`aligned_empty` allocates page-aligned arrays, so ``readinto``/
+  pread paths hit the kernel's aligned fast path and a future pinned
+  (``cudaHostRegister``-style) registration has stable addresses to pin.
+- :class:`SlabPool` is a process-wide free list keyed by
+  ``(shape, dtype)`` under a byte budget: ``take`` reuses an
+  already-faulted buffer when one matches (O(1) dict pop), ``give``
+  returns a buffer at stream teardown.  Reuse across *streams* — not
+  just within one — is the point: the serve layer reduces many
+  recordings of the same product shape back to back, and window ``w+1``
+  of a scan stages through the slabs window ``w`` just released.
+
+The pool is deliberately dumb: exact shape+dtype match only (a near-miss
+realloc is as cheap as the old path), FIFO eviction when over budget,
+and counters (``staging.reuse`` / ``staging.alloc`` / ``staging.drop``)
+on the process timeline so the hit rate is observable in every telemetry
+report.  ``BLIT_STAGING_BYTES`` overrides the budget per process
+(``0`` disables pooling entirely — every ``take`` allocates, every
+``give`` drops — the A/B lever).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+_ALIGN = 4096  # page size: the readinto/pread alignment contract
+
+# Default pool budget: enough for a deep hi-res chunk rotation (a few
+# ~100-600 MB chunk buffers) without letting a shape-churning test suite
+# hoard RSS.  Per-process; env-overridable.
+_DEFAULT_BUDGET = 2 << 30
+
+
+def aligned_empty(shape, dtype, align: int = _ALIGN) -> np.ndarray:
+    """An uninitialized C-contiguous array whose data pointer is
+    ``align``-byte aligned (page-aligned by default).  NumPy's own
+    allocations guarantee only 16/64-byte alignment; O_DIRECT-grade
+    reads and host-memory registration both want pages."""
+    dtype = np.dtype(dtype)
+    nbytes = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+    raw = np.empty(nbytes + align, np.uint8)
+    off = (-raw.ctypes.data) % align
+    # The slice keeps ``raw`` alive via .base — no dangling storage.
+    return raw[off:off + nbytes].view(dtype).reshape(shape)
+
+
+class SlabPool:
+    """Process-wide staging-buffer free list (module docstring).
+
+    Thread-safe: producers (BufferRotation fill threads), readback
+    threads and consumers all take/give concurrently.  A taken buffer is
+    the caller's until given back; the pool never hands one buffer to
+    two callers.
+    """
+
+    def __init__(self, budget_bytes: Optional[int] = None):
+        if budget_bytes is None:
+            env = os.environ.get("BLIT_STAGING_BYTES")
+            if env is not None:
+                budget_bytes = int(env)
+            else:
+                from blit.config import DEFAULT
+
+                cfg = getattr(DEFAULT, "staging_pool_bytes", None)
+                budget_bytes = _DEFAULT_BUDGET if cfg is None else int(cfg)
+        self.budget_bytes = budget_bytes
+        self._lock = threading.Lock()
+        # (shape, dtype.str) -> list of free arrays; OrderedDict gives
+        # FIFO key eviction (oldest shape class dropped first).
+        self._free: "OrderedDict[Tuple, List[np.ndarray]]" = OrderedDict()
+        self._free_bytes = 0
+        self.reused = 0
+        self.allocated = 0
+        self.dropped = 0
+
+    def _count(self, name: str, n: int = 1) -> None:
+        try:  # telemetry must never break staging
+            from blit import observability
+
+            observability.process_timeline().count(name, n)
+        except Exception:  # noqa: BLE001 — counters are best-effort
+            pass
+
+    def take(self, shape, dtype=np.int8) -> np.ndarray:
+        """A free buffer of exactly ``(shape, dtype)`` — already faulted
+        when reused — else a fresh aligned allocation."""
+        key = (tuple(shape), np.dtype(dtype).str)
+        with self._lock:
+            lst = self._free.get(key)
+            if lst:
+                arr = lst.pop()
+                if not lst:
+                    del self._free[key]
+                self._free_bytes -= arr.nbytes
+                self.reused += 1
+            else:
+                arr = None
+                self.allocated += 1
+        if arr is None:
+            arr = aligned_empty(shape, dtype)
+            self._count("staging.alloc")
+        else:
+            self._count("staging.reuse")
+        return arr
+
+    def give(self, arr: Optional[np.ndarray]) -> None:
+        """Return a buffer to the pool (dropped when over budget or not
+        pool-eligible — non-contiguous views stage nothing)."""
+        if arr is None or not arr.flags.c_contiguous:
+            return
+        key = (arr.shape, arr.dtype.str)
+        ndrop = 0
+        with self._lock:
+            if self.budget_bytes <= 0 or arr.nbytes > self.budget_bytes:
+                self.dropped += 1
+                ndrop = 1
+            else:
+                self._free.setdefault(key, []).append(arr)
+                self._free_bytes += arr.nbytes
+                while self._free_bytes > self.budget_bytes and self._free:
+                    # FIFO: evict from the oldest shape class.
+                    k, lst = next(iter(self._free.items()))
+                    old = lst.pop(0)
+                    if not lst:
+                        del self._free[k]
+                    self._free_bytes -= old.nbytes
+                    self.dropped += 1
+                    ndrop += 1
+        if ndrop:
+            # Budget-driven evictions count too: the telemetry counter
+            # must agree with stats()["dropped"], or an operator A/B-ing
+            # BLIT_STAGING_BYTES via telemetry sees a healthy pool that
+            # is actually thrashing.
+            self._count("staging.drop", ndrop)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "free_bytes": self._free_bytes,
+                "free_slabs": sum(len(v) for v in self._free.values()),
+                "reused": self.reused,
+                "allocated": self.allocated,
+                "dropped": self.dropped,
+                "budget_bytes": self.budget_bytes,
+            }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._free.clear()
+            self._free_bytes = 0
+
+
+_POOL: Optional[SlabPool] = None
+_POOL_LOCK = threading.Lock()
+
+
+def slab_pool() -> SlabPool:
+    """The process-wide staging pool (lazily constructed so the env
+    budget is read at first use, not import)."""
+    global _POOL
+    with _POOL_LOCK:
+        if _POOL is None:
+            _POOL = SlabPool()
+        return _POOL
+
+
+def _reset_pool() -> None:
+    """Drop the global pool (tests re-read the env budget)."""
+    global _POOL
+    with _POOL_LOCK:
+        _POOL = None

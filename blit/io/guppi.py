@@ -165,6 +165,7 @@ class GuppiRaw(_BlockStream):
         self.path = path
         self.headers: List[Dict] = []
         self._data_offsets: List[int] = []
+        self._pread_fd: Optional[int] = None  # lazy readinto descriptor
         if native is None or native:
             from blit.io.native import guppi_lib
 
@@ -304,6 +305,19 @@ class GuppiRaw(_BlockStream):
                         dst,
                         dst.strides[0],
                     )
+                elif dst[0].flags.c_contiguous and hasattr(os, "preadv"):
+                    # Pure-python readinto fast path (ISSUE 8): positional
+                    # pread of each channel row STRAIGHT into the staging
+                    # slab — no mmap setup/teardown per block, no
+                    # page-fault-driven copy, one syscall per channel.
+                    # The persistent fd is positionless (pread), so the
+                    # producer thread needs no seek locking.  preadv is
+                    # POSIX-but-not-macOS; platforms without it take the
+                    # memmap leg below.
+                    self._pread_rows(
+                        dst, self._data_offsets[i] + t0 * samp_bytes,
+                        nchan, nt * samp_bytes, ntime * samp_bytes,
+                    )
                 else:
                     mm = np.memmap(
                         self.path,
@@ -318,6 +332,50 @@ class GuppiRaw(_BlockStream):
             return nt
 
         return faults.retry_io(_read, describe=f"guppi read {self.path}")
+
+    def _pread_rows(self, dst: np.ndarray, base_off: int, nchan: int,
+                    row_bytes: int, row_stride: int) -> None:
+        """pread ``row_bytes`` of each of ``nchan`` on-disk channel rows
+        (``row_stride`` apart, starting at ``base_off``) into
+        ``dst[c, :]``'s contiguous storage (the readinto leg of
+        :meth:`read_block_into`)."""
+        fd = self._pread_fd
+        if fd is None:
+            fd = self._pread_fd = os.open(self.path, os.O_RDONLY)
+        for c in range(nchan):
+            view = memoryview(dst[c]).cast("B")[:row_bytes]
+            off = base_off + c * row_stride
+            done = 0
+            while done < row_bytes:
+                # A single preadv is capped (~2 GiB on Linux) and may
+                # legally return short — loop until the row is complete;
+                # only a zero return (EOF) means the file really ends
+                # mid-row.
+                got = os.preadv(fd, [view[done:]], off + done)
+                if got <= 0:
+                    # EOF mid-row is DETERMINISTIC (a truncated file
+                    # re-reads identically) — raise a non-OSError so
+                    # faults.transient_io doesn't burn the retry/backoff
+                    # budget re-reading it.
+                    raise EOFError(
+                        f"{self.path}: short pread ({done} of "
+                        f"{row_bytes} bytes at offset {off}) — "
+                        "truncated recording?"
+                    )
+                done += got
+
+    def close(self) -> None:
+        """Release the persistent pread descriptor (idempotent; the
+        reader stays usable — the fd reopens on demand)."""
+        fd, self._pread_fd = self._pread_fd, None
+        if fd is not None:
+            os.close(fd)
+
+    def __del__(self):  # best-effort: interpreter teardown tolerant
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001
+            pass
 
     def read_block_complex(self, i: int) -> np.ndarray:
         """Block ``i`` as complex64, shaped ``(obsnchan, ntime, npol)``."""

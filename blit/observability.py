@@ -193,6 +193,22 @@ class HistogramStats:
         return {"counts": list(self.counts), "n": self.n,
                 "total": self.total, "vmin": self.vmin, "vmax": self.vmax}
 
+    def since(self, st: Dict) -> "HistogramStats":
+        """A NEW histogram holding only the samples observed after ``st``
+        (a prior :meth:`state`).  Bucket counts / n / total subtract
+        exactly; the [min, max] envelope is not invertible, so the delta
+        keeps the cumulative one — quantile bucket midpoints stay
+        correct, only the envelope clamp is wider than the true window."""
+        h = HistogramStats()
+        old = st.get("counts", [])
+        for i in range(_HIST_NBUCKETS):
+            prev = old[i] if i < len(old) else 0
+            h.counts[i] = max(0, self.counts[i] - prev)
+        h.n = max(0, self.n - int(st.get("n", 0)))
+        h.total = max(0.0, self.total - float(st.get("total", 0.0)))
+        h.vmin, h.vmax = self.vmin, self.vmax
+        return h
+
     @classmethod
     def from_state(cls, st: Dict) -> "HistogramStats":
         h = cls()
@@ -337,6 +353,26 @@ class Timeline:
         (safe against concurrent producer-thread stage insertion)."""
         return {k: (v.calls, v.seconds, v.bytes)
                 for k, v in list(self.stages.items())}
+
+    def hist_quantiles(self, names: Optional[Iterable[str]] = None
+                       ) -> Dict[str, Dict]:
+        """p50/p99 (+n, max) per named histogram — the compact tail block
+        the bench tables embed beside stage means (ISSUE 8 satellite:
+        operators read readback/write/chunk-latency TAILS, an average
+        hides the burst that actually stalled the plane).  ``names=None``
+        reports every histogram with samples."""
+        keys = list(self.hists) if names is None else list(names)
+        out = {}
+        for k in keys:
+            h = self.hists.get(k)
+            if h is None or h.n == 0:
+                continue
+            # One quantile-report implementation: project the compact
+            # shape out of HistogramStats.report so rounding/percentile
+            # changes there propagate here.
+            rep = h.report()
+            out[k] = {f: rep[f] for f in ("n", "p50", "p99", "max")}
+        return out
 
     def since(self, snap: Dict[str, tuple]) -> Dict[str, Dict]:
         """Per-stage deltas since a :meth:`snapshot` — the per-window stage

@@ -73,6 +73,24 @@ log = logging.getLogger("blit.outplane")
 
 _EOF = object()
 
+# The output plane's per-chunk histograms, in the order the bench /
+# ingest-bench / tune stage_quantiles blocks report them.  One constant —
+# adding or renaming a hist here updates every report surface at once.
+INGEST_HISTS = ("out.chunk_latency_s", "out.readback_lag_s", "out.write_s")
+
+
+def readback_extra_slots(out_depth: int, prefetch_depth: int) -> int:
+    """Chunk-rotation widening required by the readback plane: a
+    readback deeper than the producer's prefetch pins more un-synced
+    chunk buffers than ``prefetch_depth`` provides, so the rotation must
+    grow by the difference plus one read-ahead slot — otherwise the
+    producer starves (and the all-slots-held starvation heuristic stops
+    being a true bug signal).  Shared by every plane that pairs a chunk
+    :class:`~blit.pipeline.BufferRotation` with an
+    :class:`OutputRotation` (reduce and search) so the invariant cannot
+    drift between them."""
+    return 1 + max(0, max(2, out_depth) - max(2, prefetch_depth))
+
 
 class OutputSlab:
     """A completed readback handed to the consumer: ``data`` is the host
@@ -245,6 +263,7 @@ class OutputRotation:
         back-pressure from the sink, not a readback stall — the beat keeps
         ticking.  Returns None if closed while waiting."""
         alloc_shape = None
+        evicted = None
         with self._cv:
             while True:
                 for i, s in enumerate(self._free):
@@ -255,19 +274,38 @@ class OutputRotation:
                     alloc_shape = shape
                     break
                 if self._free:  # at the limit, none match: replace one
-                    self._free.pop()
+                    evicted = self._free.pop()
                     alloc_shape = shape
                     break
                 if self._stop.is_set():
                     return None
                 self._wd.beat()
                 self._cv.wait(timeout=0.2)
-        return np.empty(alloc_shape, dtype)
+        # Aligned, pool-recycled staging (blit/hostmem.py): a previous
+        # stream's already-faulted slab when one matches.
+        from blit import hostmem
+
+        pool = hostmem.slab_pool()
+        if evicted is not None:
+            # The replaced steady-state slab retires to the staging pool
+            # (the close() rule) — not to the GC.
+            pool.give(evicted)
+        return pool.take(alloc_shape, dtype)
 
     def _release_slab(self, slab: np.ndarray) -> None:
         with self._cv:
-            self._free.append(slab)
-            self._cv.notify_all()
+            if not self._stop.is_set():
+                self._free.append(slab)
+                self._cv.notify_all()
+                return
+        # Released after close() swept the ring (e.g. the AsyncSink
+        # draining its write-behind tail): retire straight to the staging
+        # pool — appending to a closed rotation's _free just feeds the GC
+        # and makes the next stream re-pay allocation + first-touch
+        # faults for its tail slabs.
+        from blit import hostmem
+
+        hostmem.slab_pool().give(slab)
 
     # -- consumer side -----------------------------------------------------
     def _poll(self) -> float:
@@ -343,6 +381,19 @@ class OutputRotation:
                 "abandoning the daemon thread", self._thread.name,
                 join_timeout_s,
             )
+            return
+        # Joined cleanly: retire the free ring slabs to the process
+        # staging pool (blit/hostmem.py) so the next stream's readback
+        # ring reuses already-faulted host memory.  Slabs still held by
+        # consumers stay theirs; _release_slab retires them to the pool
+        # too once they come back (the sink's write-behind tail).
+        from blit import hostmem
+
+        pool = hostmem.slab_pool()
+        with self._cv:
+            free, self._free = self._free, []
+        for s in free:
+            pool.give(s)
 
 
 class _FlushBarrier:
@@ -449,8 +500,14 @@ class AsyncSink:
             if self._exc is None:
                 try:
                     faults.fire("sink.write", key=self._key)
+                    t0 = time.perf_counter()
                     with self._tl.stage("write", nbytes=slab.nbytes):
                         self._writer.append(slab)
+                    # Per-append latency distribution (ISSUE 8 satellite:
+                    # the bench tables report write p50/p99, not just the
+                    # stage mean — a bursty disk hides behind an average).
+                    self._tl.observe("out.write_s",
+                                     time.perf_counter() - t0)
                 except BaseException as e:  # noqa: BLE001 — consumer re-raises
                     self._exc = e
             # Release even after a failure: later slabs are skipped, but

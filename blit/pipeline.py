@@ -275,8 +275,14 @@ class RawReducer:
     # Chunk buffers in the ingest rotation (>= 2).  2 = classic double
     # buffering: the producer thread reads chunk i+1 from the file while the
     # device works on chunk i.  Host memory held: prefetch_depth chunk-sized
-    # int8 buffers.
-    prefetch_depth: int = 2
+    # int8 buffers.  None (the default) = this rig's tuning profile when
+    # one exists (blit/tune.py), else 2.
+    prefetch_depth: Optional[int] = None
+    # Output-plane depth: device outputs in readback flight + write-behind
+    # queue slots (blit/outplane.py).  None = the tuning profile, else
+    # prefetch_depth.  Deeper hides a laggier D2H link at the cost of one
+    # pinned chunk buffer (and its HBM output) per extra slot.
+    out_depth: Optional[int] = None
     # Working dtype of the channelizer's DFT stages ("float32"|"bfloat16").
     # bf16 halves the inter-stage HBM, fitting ~2x the frames per dispatch
     # at a measured accuracy cost (DESIGN.md §8).
@@ -302,18 +308,70 @@ class RawReducer:
     # threads (None = wait forever), the BufferRotation stall_timeout_s
     # twin on the result side.
     output_stall_timeout_s: Optional[float] = None
+    # Quantized product narrowing (ISSUE 8 tentpole c): nbits=8/16 writes
+    # SIGPROC ``.fil`` products in their narrow on-disk integer form —
+    # quantized ON DEVICE before D2H on the async plane (4x/2x fewer
+    # bytes across the slow link), on the host on the sync path, with
+    # bit-identical results either way (blit/ops/narrow.py).  The fixed
+    # affine rule is ``clip(rint(x*scale + offset), 0, 2^nbits-1)``;
+    # scale/offset are the caller's (global stats don't exist mid-stream).
+    nbits: int = 32
+    quant_scale: float = 1.0
+    quant_offset: float = 0.0
+    # Online autotuning (blit/tune.py): after the first windows of a
+    # streaming reduction, derive a knob recommendation from the live
+    # stage timeline (published as tune.rec_* gauges; persisted as a
+    # tuning profile when BLIT_TUNE_ONLINE=1).
+    tune_online: bool = True
 
     def __post_init__(self):
-        import jax.numpy as jnp
+        from blit.ops.narrow import check_quant
 
         if os.environ.get("BLIT_SYNC_OUTPUT"):
             self.async_output = False
+        check_quant(self.nbits)
         self._output_frames = 0
         # Chunk-buffer cache: streams on the same reducer reuse (already
         # page-faulted) rotation buffers — first-touch faults on GB-sized
-        # buffers otherwise dominate short runs.  One stream at a time per
-        # reducer instance.
+        # buffers otherwise dominate short runs.  Backed by the process-wide
+        # staging pool (blit/hostmem.py): buffers retire to the pool at the
+        # end of a completed stream, so the NEXT reducer (a serve-layer
+        # request, the next scan window) stages through already-faulted
+        # aligned slabs too.  One stream at a time per reducer instance.
         self._buf_cache: List[np.ndarray] = []
+
+        # Per-rig tuning profile (ISSUE 8): knobs the caller left unset
+        # resolve from this rig's content-addressed profile when one
+        # exists — `blit tune` (or an online-converged run) wrote it; a
+        # profile for a different rig/workload shape hashes to a
+        # different key and is never found.  BLIT_TUNE=0 disables.
+        self._tuning_profile = None
+        self._stream_nchan: Optional[int] = None
+        self._profile_nchan_mismatch: Optional[int] = None
+        self._knob_sources = {
+            "chunk_frames": "explicit" if self.chunk_frames is not None
+            else "default",
+            "prefetch_depth": "explicit" if self.prefetch_depth is not None
+            else "default",
+            "out_depth": "explicit" if self.out_depth is not None
+            else "default",
+        }
+        if (self.chunk_frames is None or self.prefetch_depth is None
+                or self.out_depth is None):
+            from blit import tune as _tune
+
+            prof = _tune.lookup(**self._tune_fingerprint_kw())
+            if prof is not None:
+                self._tuning_profile = prof
+                for knob, value in prof.knobs().items():
+                    if getattr(self, knob) is None:
+                        setattr(self, knob, value)
+                        self._knob_sources[knob] = "profile"
+        if self.prefetch_depth is None:
+            self.prefetch_depth = 2
+        if self.out_depth is None:
+            self.out_depth = max(2, self.prefetch_depth)
+        self.out_depth = max(2, self.out_depth)
 
         if self.chunk_frames is None:
             # Budget-driven default: ~8M samples per coarse channel per device
@@ -330,7 +388,98 @@ class RawReducer:
             raise ValueError(
                 f"fqav_by={self.fqav_by} does not divide nfft={self.nfft}"
             )
-        self._coeffs = jnp.asarray(pfb_coeffs(self.ntap, self.nfft, self.window))
+        self._pfb_coeffs = None  # built lazily by the _coeffs property
+
+    @property
+    def _coeffs(self):
+        """PFB coefficient bank, built (and device-shipped) on FIRST
+        compute use — not at construction.  Throwaway probe reducers
+        (scan/ingest-bench resolve tuning knobs through one) must not
+        pay a multi-million-coefficient sinc*window build plus device
+        transfer just to read provenance."""
+        if self._pfb_coeffs is None:
+            import jax.numpy as jnp
+
+            self._pfb_coeffs = jnp.asarray(
+                pfb_coeffs(self.ntap, self.nfft, self.window))
+        return self._pfb_coeffs
+
+    def _tune_fingerprint_kw(self) -> Dict:
+        """The (rig, workload-shape) fingerprint components of this
+        reduction — what a tuning profile is keyed under
+        (:func:`blit.tune.rig_fingerprint`)."""
+        return dict(
+            nfft=self.nfft, ntap=self.ntap, nint=self.nint,
+            stokes=self.stokes, window=self.window, fqav_by=self.fqav_by,
+            dtype=self.dtype, fft_method=self.fft_method, nbits=self.nbits,
+            workload="reduce",
+        )
+
+    def tuning_provenance(self) -> Dict:
+        """Where this reducer's ingest knobs came from — embedded in the
+        bench/ingest-bench ``ingest_config`` blocks so every recorded
+        number names the profile (or default) behind it."""
+        prov = {
+            "chunk_frames": self.chunk_frames,
+            "prefetch_depth": self.prefetch_depth,
+            "out_depth": self.out_depth,
+            "sources": dict(self._knob_sources),
+        }
+        if self._tuning_profile is not None:
+            prov["profile"] = self._tuning_profile.provenance()
+        if self._profile_nchan_mismatch is not None:
+            prov["profile_nchan_mismatch"] = {
+                "tuned": self._profile_nchan_mismatch,
+                "stream": self._stream_nchan,
+            }
+        return prov
+
+    def _note_stream_nchan(self, nchan: int) -> None:
+        """Profile-staleness guard: the rig fingerprint deliberately
+        excludes the recording's channel count (lookup happens at
+        construction, before any recording is open, and tuning transfers
+        across same-shaped workloads) — but per-chunk staging bytes and
+        stage cost scale linearly with it.  Warn once per stream when a
+        loaded profile was measured on a different-width recording, and
+        surface the mismatch in :meth:`tuning_provenance`."""
+        if self._stream_nchan == nchan:
+            return
+        self._stream_nchan = nchan
+        prof = self._tuning_profile
+        tuned = int(getattr(prof, "tuned_nchan", 0) or 0) if prof else 0
+        if tuned and tuned != nchan:
+            self._profile_nchan_mismatch = tuned
+            log.warning(
+                "tuning profile %s was measured on a %d-channel recording "
+                "but this stream has %d channels; per-chunk cost scales "
+                "with the channel count — re-run `blit tune` on a matching "
+                "recording (or set chunk_frames/prefetch_depth/out_depth "
+                "explicitly) if ingest underperforms",
+                prof.key[:12], tuned, nchan,
+            )
+
+    def _narrow_host(self, slab: np.ndarray) -> np.ndarray:
+        """The synchronous-path product narrowing (identity at nbits=32):
+        the host twin of the device-side narrowing in
+        :meth:`_stream_async` (blit/ops/narrow.py pins them bitwise)."""
+        from blit.ops.narrow import narrow_host
+
+        if self.nbits == 32:
+            return np.ascontiguousarray(slab)
+        return narrow_host(slab, self.nbits, self.quant_scale,
+                           self.quant_offset)
+
+    def _retire_staging(self) -> None:
+        """Return the stream's chunk buffers to the process staging pool
+        (blit/hostmem.py) — called only after a TERMINAL sync (stream
+        fully drained / sink closed), never on an error path where an
+        un-synced dispatch might still read a buffer."""
+        from blit import hostmem
+
+        pool = hostmem.slab_pool()
+        for b in self._buf_cache:
+            pool.give(b)
+        self._buf_cache = []
 
     @property
     def stats(self) -> ReductionStats:
@@ -372,8 +521,11 @@ class RawReducer:
         return out
 
     def stream(self, raw: GuppiRaw, skip_frames: int = 0) -> Iterator[np.ndarray]:
-        """Yield float32 filterbank slabs ``(nspectra, nif, nchan*nfft)``
-        covering the file gap-free (PFB state carried across blocks).
+        """Yield filterbank slabs ``(nspectra, nif, nchan*nfft)`` covering
+        the file gap-free (PFB state carried across blocks).  Slabs are
+        float32 — or, with ``nbits=8/16``, the same quantized narrow dtype
+        :meth:`reduce_to_file` writes (the knob applies uniformly: the
+        in-memory product always matches the on-disk bytes).
 
         ``skip_frames`` skips the first N output frames exactly — frame N's
         PFB window starts at sample ``N*nfft`` of the gap-free stream, so
@@ -398,15 +550,21 @@ class RawReducer:
                     finally:
                         chunk.release()
                     self._output_frames += chunk.frames
-                    yield out
+                    yield self._narrow_host(out)
+                self._retire_staging()
                 return
-            for slab in self._stream_async(raw, skip_frames, reuse=False):
+            for slab in self._stream_async(raw, skip_frames, reuse=False,
+                                           narrow=True):
                 data = slab.data
                 slab.release()
                 yield data
+            # Normal exhaustion only: every dispatch synced, so the chunk
+            # buffers are safe to hand to the next reducer via the pool.
+            self._retire_staging()
 
     def _stream_async(self, raw: GuppiRaw, skip_frames: int,
-                      reuse: bool) -> Iterator["object"]:
+                      reuse: bool, narrow: bool = False,
+                      tuner=None) -> Iterator["object"]:
         """The overlapped streaming core behind :meth:`stream` and
         :meth:`_pump`: async-dispatch each chunk, hand the in-flight
         output to an :class:`blit.outplane.OutputRotation` readback
@@ -428,21 +586,37 @@ class RawReducer:
         """
         import jax
 
-        from blit.outplane import OutputRotation
+        from blit.outplane import OutputRotation, readback_extra_slots
 
+        depth = max(2, self.out_depth)
         rot = OutputRotation(
-            depth=max(2, self.prefetch_depth),
+            depth=depth,
             timeline=self.timeline, reuse=reuse, name="blit-readback",
             stall_timeout_s=self.output_stall_timeout_s,
         )
+        do_narrow = narrow and self.nbits < 32
+        if do_narrow:
+            from blit.ops.narrow import narrow_device
         try:
-            for chunk in self._chunks(raw, skip_frames, extra_slots=1):
+            extra = readback_extra_slots(depth, self.prefetch_depth)
+            for chunk in self._chunks(raw, skip_frames, extra_slots=extra):
                 with self.timeline.stage("dispatch", byte_free=True):
                     out = channelize(
                         jax.numpy.asarray(chunk.view), self._coeffs,
                         **self._channelize_kw,
                     )
+                    if do_narrow:
+                        # Quantize to the product's on-disk integer form
+                        # BEFORE D2H: 4x (nbits=8) / 2x (nbits=16) fewer
+                        # bytes cross the slow link, bit-identical to the
+                        # sync path's host-side narrowing
+                        # (blit/ops/narrow.py).
+                        out = narrow_device(out, self.nbits,
+                                            self.quant_scale,
+                                            self.quant_offset)
                 self._output_frames += chunk.frames
+                if tuner is not None:
+                    tuner.observe_chunk()
                 for slab in rot.put(out, nbytes=chunk.view.nbytes,
                                     on_consumed=chunk.release):
                     yield slab
@@ -469,9 +643,12 @@ class RawReducer:
         A/B drills."""
         if not self.async_output:
             try:
-                # stream() opens the profiler trace itself on this path.
+                # stream() opens the profiler trace itself on this path,
+                # and narrows quantized products HOST-side — the twin of
+                # the async plane's on-device narrowing (byte-identical,
+                # blit/ops/narrow.py).
                 for slab in self.stream(raw, skip_frames=skip_frames):
-                    writer.append(np.ascontiguousarray(slab))
+                    writer.append(slab)
                 writer.close()
             except BaseException:
                 writer.abort()
@@ -480,8 +657,19 @@ class RawReducer:
 
         from blit.outplane import AsyncSink
 
+        tuner = None
+        if self.tune_online:
+            from blit.tune import OnlineTuner
+
+            tuner = OnlineTuner(
+                self.timeline,
+                {"chunk_frames": self.chunk_frames,
+                 "prefetch_depth": self.prefetch_depth,
+                 "out_depth": self.out_depth},
+                nint=self.nint,
+            )
         sink = AsyncSink(
-            writer, depth=max(2, self.prefetch_depth),
+            writer, depth=max(2, self.out_depth),
             timeline=self.timeline,
             stall_timeout_s=self.output_stall_timeout_s,
         )
@@ -491,7 +679,8 @@ class RawReducer:
                 out=str(getattr(writer, "path", "")),
             ):
                 for slab in self._stream_async(raw, skip_frames,
-                                               reuse=True):
+                                               reuse=True,
+                                               narrow=True, tuner=tuner):
                     sink.append(slab.data, release=slab.release)
                 # Final flush barrier + writer finalization; the write
                 # tail is streaming wall time like the readback tail.
@@ -504,6 +693,10 @@ class RawReducer:
             sink.abort()
             raise
         self.timeline.overlap_efficiency()
+        self._retire_staging()
+        if tuner is not None:
+            tuner.maybe_persist(tuned_nchan=self._stream_nchan or 0,
+                                **self._tune_fingerprint_kw())
         return sink.nsamps
 
     def _producer(
@@ -563,6 +756,7 @@ class RawReducer:
             t0, nt = to_skip, nt - to_skip
             to_skip = 0
             nchan = hdr["OBSNCHAN"]
+            self._note_stream_nchan(nchan)
             npol = 2 if hdr["NPOL"] > 2 else hdr["NPOL"]
             while nt > 0:
                 if cur is None:
@@ -580,7 +774,15 @@ class RawReducer:
                                 bufs[cur] = self._buf_cache.pop(j)
                                 break
                         else:
-                            bufs[cur] = np.empty(shape, np.int8)
+                            # Page-aligned, pool-recycled staging slab
+                            # (blit/hostmem.py): an already-faulted buffer
+                            # from a previous stream when one matches, so
+                            # steady-state ingest never allocates.
+                            from blit import hostmem
+
+                            bufs[cur] = hostmem.slab_pool().take(
+                                shape, np.int8
+                            )
                     if prev is not None:
                         # Separate stage: filter-state memcpy between
                         # buffers is not file ingest ("ingest" bytes
@@ -686,6 +888,7 @@ class RawReducer:
                 done, s = pending.popleft()
                 total += float(s)
                 done.release()
+            self._retire_staging()
             return total
 
     # -- whole-file conveniences ------------------------------------------
@@ -718,6 +921,8 @@ class RawReducer:
         """Reduce a whole RAW file — or a whole multi-file ``.NNNN.raw``
         scan sequence (path list / stem, blit/io/guppi.open_raw) — in memory
         → ``(filterbank_header, data)`` with data ``(nsamps, nif, nchans)``."""
+        from blit.ops.narrow import NARROW_DTYPES
+
         raw, hdr = self._open_validated(raw_src)
         with observability.span("reduce", nfft=self.nfft):
             slabs = list(self.stream(raw))
@@ -727,8 +932,12 @@ class RawReducer:
             # Zero usable frames: shape the empty product off the header so
             # the channel axis stays consistent (fqav_by included).
             data = np.zeros(
-                (0, STOKES_NIF[self.stokes], hdr["nchans"]), np.float32
+                (0, STOKES_NIF[self.stokes], hdr["nchans"]),
+                NARROW_DTYPES[self.nbits],
             )
+        # stream() already narrowed nbits=8/16 products; the header must
+        # say so or a later write_fil of (hdr, data) lies about the dtype.
+        hdr["nbits"] = self.nbits
         hdr["nsamps"] = data.shape[0]
         return hdr, data
 
@@ -751,6 +960,9 @@ class RawReducer:
         if out_path.endswith((".h5", ".hdf5")):
             from blit.io.fbh5 import FBH5Writer
 
+            if self.nbits != 32:
+                raise ValueError("nbits=8/16 quantized output is a SIGPROC "
+                                 ".fil feature; FBH5 products are float32")
             raw, hdr = self._open_validated(raw_src)
             nif = STOKES_NIF[self.stokes]
             w = FBH5Writer(
@@ -766,6 +978,7 @@ class RawReducer:
         if chunks is not None:
             raise ValueError("chunks applies to .h5 output")
         from blit.io.sigproc import FilWriter
+        from blit.ops.narrow import NARROW_DTYPES
 
         raw, hdr = self._open_validated(raw_src)
         nif = STOKES_NIF[self.stokes]
@@ -774,8 +987,10 @@ class RawReducer:
         # not leave a VALID-looking truncated product at out_path (silent
         # data loss for consumers that treat existence as completion).
         # Resumable partial products are reduce_resumable's job — there the
-        # cursor sidecar marks incompleteness.
-        w = FilWriter(out_path, hdr, nif, hdr["nchans"])
+        # cursor sidecar marks incompleteness.  nbits<32 writes the narrow
+        # quantized form (the header's nbits follows the writer dtype).
+        w = FilWriter(out_path, hdr, nif, hdr["nchans"],
+                      dtype=NARROW_DTYPES[self.nbits])
         with observability.span("reduce.to_file", out=out_path):
             hdr["nsamps"] = self._pump(raw, w)
         return hdr
@@ -806,6 +1021,9 @@ class RawReducer:
         ``.h5`` output only and are part of the resume identity.
         """
         is_h5 = out_path.endswith((".h5", ".hdf5"))
+        if is_h5 and self.nbits != 32:
+            raise ValueError("nbits=8/16 quantized output is a SIGPROC "
+                             ".fil feature; FBH5 products are float32")
         if not is_h5 and compression is not None:
             raise ValueError(".fil products are uncompressed; compression "
                              "applies to .h5 output")
@@ -853,6 +1071,8 @@ class RawReducer:
                 window=self.window, raw_size=size, raw_mtime_ns=mtime_ns,
                 fqav_by=self.fqav_by, dtype=self.dtype,
                 compression=comp_id, chunks=chunks_id,
+                nbits=self.nbits, quant_scale=self.quant_scale,
+                quant_offset=self.quant_offset,
             )
         start_rows = cur.frames_done // self.nint if resuming else 0
         if is_h5:
@@ -863,8 +1083,11 @@ class RawReducer:
                 cur, compression=compression, chunks=chunks,
             )
         else:
+            from blit.ops.narrow import NARROW_DTYPES
+
             w = ResumableFilWriter(
-                out_path, hdr, nif, hdr["nchans"], start_rows, self.nint, cur
+                out_path, hdr, nif, hdr["nchans"], start_rows, self.nint,
+                cur, dtype=NARROW_DTYPES[self.nbits],
             )
         # _pump aborts the writer on error — file + cursor stay as the
         # resume point (the writer's own crash contract); under the async
@@ -892,13 +1115,15 @@ class ResumableFilWriter:
     """
 
     def __init__(self, path: str, header: Dict, nif: int, nchans: int,
-                 start_rows: int, nint: int, cursor: "ReductionCursor"):
+                 start_rows: int, nint: int, cursor: "ReductionCursor",
+                 dtype=np.float32):
         from blit.io.sigproc import read_fil_header, write_fil
 
         self.path = path
         self._nint = nint
         self._nif = nif
         self._nchans = nchans
+        self.dtype = np.dtype(dtype)
         self.cursor = cursor
         if start_rows > 0 and os.path.exists(path):
             # The cursor may record more frames than the agreed restart
@@ -907,12 +1132,13 @@ class ResumableFilWriter:
             # append would leave it claiming bytes the truncate dropped.
             _, off = read_fil_header(path)
             with open(path, "r+b") as f:
-                f.truncate(off + start_rows * nif * nchans * 4)
+                f.truncate(off + start_rows * nif * nchans
+                           * self.dtype.itemsize)
             cursor.frames_done = start_rows * nint
             cursor.save(path)
         else:
             start_rows = 0
-            write_fil(path, header, np.zeros((0, nif, nchans), np.float32))
+            write_fil(path, header, np.zeros((0, nif, nchans), self.dtype))
             cursor.frames_done = 0
             cursor.save(path)
         self._f = open(path, "ab")
@@ -921,8 +1147,7 @@ class ResumableFilWriter:
     def append(self, slab: np.ndarray) -> None:
         from blit.io.sigproc import validate_slab
 
-        slab = validate_slab(slab, self._nif, self._nchans,
-                             np.dtype(np.float32))
+        slab = validate_slab(slab, self._nif, self._nchans, self.dtype)
         slab.tofile(self._f)
         # Durable data BEFORE the cursor claims it (power-loss ordering).
         self._f.flush()
@@ -1011,6 +1236,14 @@ class ReductionCursor:
     # fresh, not die on the writer's chunk-mismatch refusal.  None = the
     # writer's clamped default (deterministic for a given product shape).
     chunks: Optional[List[int]] = None
+    # Quantized-product identity (ISSUE 8): nbits and the affine quantize
+    # rule change every product byte, so a resume under different
+    # quantization must start fresh — splicing 8-bit and float spectra
+    # into one file would corrupt it silently.  Defaults keep pre-existing
+    # sidecars loadable (they claim the f32 identity they were).
+    nbits: int = 32
+    quant_scale: float = 1.0
+    quant_offset: float = 0.0
 
     @staticmethod
     def stat_raw(raw_path: Union[str, Sequence[str]]) -> Tuple:
@@ -1084,4 +1317,7 @@ class ReductionCursor:
             and self.fqav_by == red.fqav_by
             and self.dtype == red.dtype
             and self.despike_nfpc == getattr(red, "despike_nfpc", -1)
+            and self.nbits == getattr(red, "nbits", 32)
+            and self.quant_scale == getattr(red, "quant_scale", 1.0)
+            and self.quant_offset == getattr(red, "quant_offset", 0.0)
         )

@@ -98,7 +98,10 @@ class DedopplerReducer:
     # "pallas" | "auto"; interpret=True runs the pallas kernel on CPU.
     kernel: str = "auto"
     interpret: bool = False
-    prefetch_depth: int = 2
+    # None = the rig's tuning profile via the inner RawReducer
+    # (blit/tune.py), else the RawReducer defaults.
+    prefetch_depth: Optional[int] = None
+    out_depth: Optional[int] = None
     chunk_frames: Optional[int] = None
     timeline: Timeline = field(default_factory=Timeline)
     # Async planes (window feed readback + write-behind hit sink);
@@ -134,10 +137,21 @@ class DedopplerReducer:
             nfft=self.nfft, ntap=self.ntap, nint=self.nint, stokes="I",
             window=self.window, fft_method=self.fft_method,
             dtype=self.dtype, prefetch_depth=self.prefetch_depth,
+            out_depth=self.out_depth,
             chunk_frames=self.chunk_frames, timeline=self.timeline,
             async_output=self.async_output,
             output_stall_timeout_s=self.output_stall_timeout_s,
         )
+        # The inner reducer resolved the knobs (profile or default) —
+        # mirror them so this reducer's own rotation depths agree.
+        self.prefetch_depth = self._red.prefetch_depth
+        self.out_depth = self._red.out_depth
+        if self.chunk_frames is None:
+            self.chunk_frames = self._red.chunk_frames
+
+    def tuning_provenance(self) -> Dict:
+        """Delegated to the inner RawReducer (the knobs are its)."""
+        return self._red.tuning_provenance()
 
     # -- identity ----------------------------------------------------------
     def fingerprint_extra(self) -> Dict:
@@ -308,16 +322,18 @@ class DedopplerReducer:
                     yield win.index, decode(packed, win.index)
                 return
 
-            from blit.outplane import OutputRotation
+            from blit.outplane import OutputRotation, readback_extra_slots
 
+            depth = max(2, self.out_depth)
             rot = OutputRotation(
-                depth=max(2, self.prefetch_depth), timeline=self.timeline,
+                depth=depth, timeline=self.timeline,
                 reuse=False, name="blit-search-readback",
                 stall_timeout_s=self.output_stall_timeout_s,
             )
             try:
+                extra = readback_extra_slots(depth, self.prefetch_depth)
                 for win in self._windows(raw, skip_windows, nchans,
-                                         extra_slots=1):
+                                         extra_slots=extra):
                     with self.timeline.stage("dispatch", byte_free=True):
                         packed = jfn(jnp.asarray(win.view), thr)
                     for slab in rot.put(packed, nbytes=win.view.nbytes,
@@ -383,7 +399,7 @@ class DedopplerReducer:
         from blit.outplane import AsyncSink
 
         sink = AsyncSink(
-            writer, depth=max(2, self.prefetch_depth),
+            writer, depth=max(2, self.out_depth),
             timeline=self.timeline,
             stall_timeout_s=self.output_stall_timeout_s,
         )

@@ -438,6 +438,9 @@ def stream_reduce(source: ChunkSource, out_path: str, *,
         if out_path.endswith((".h5", ".hdf5")):
             from blit.io.fbh5 import FBH5Writer
 
+            if red.nbits != 32:
+                raise ValueError("nbits=8/16 quantized output is a SIGPROC "
+                                 ".fil feature; FBH5 products are float32")
             w = FBH5Writer(out_path, hdr, nifs=nif,
                            nchans=hdr["nchans"],
                            compression=compression, chunks=chunks)
@@ -448,11 +451,20 @@ def stream_reduce(source: ChunkSource, out_path: str, *,
             if chunks is not None:
                 raise ValueError("chunks applies to .h5 output")
             from blit.io.sigproc import FilWriter
+            from blit.ops.narrow import NARROW_DTYPES
 
-            w = FilWriter(out_path, hdr, nif, hdr["nchans"])
+            # _pump delivers nbits<32 slabs already quantized narrow
+            # (reduce_to_file's writer rule) — the live product must
+            # carry the same dtype or stream==batch byte-identity breaks.
+            w = FilWriter(out_path, hdr, nif, hdr["nchans"],
+                          dtype=NARROW_DTYPES[red.nbits])
         tap = _LatencyTap(w, live, red.timeline, nfft=red.nfft,
                           ntap=red.ntap, nint=red.nint)
         hdr["nsamps"] = red._pump(live, tap)
+    # Which ingest knobs the live reduction ran (tuning profile /
+    # defaults — blit/tune.py): a slow live session's report names the
+    # knob source before anyone reaches for `blit tune`.
+    hdr["stream_tuning"] = red.tuning_provenance()
     hdr.update(live.stream_report())
     hdr["stream_degraded_spectra"] = live.degraded_rows(
         red.nfft, red.ntap, red.nint, max_rows=hdr["nsamps"])
@@ -492,6 +504,7 @@ def stream_search(source: ChunkSource, out_path: str, *,
                           window_spectra=red.window_spectra)
         hdr["search_nhits"] = red._pump(live, hdr, tap)
     hdr["search_windows"] = tap.nwindows
+    hdr["stream_tuning"] = red.tuning_provenance()
     hdr.update(live.stream_report())
     # A "row" of T·nint frames IS one search window: the degraded count
     # lands in window units directly.

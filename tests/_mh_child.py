@@ -42,6 +42,13 @@ def main() -> None:
     assert active, "expected an active multi-process runtime"
     assert jax.process_count() == nproc, jax.process_count()
 
+    # Bring-up barrier marker: the parent times the WORK phase from here,
+    # not from fork — coordinator/gloo bring-up legitimately runs long on
+    # loaded CI machines (tests/test_multiprocess.py).
+    from blit.testing import signal_ready
+
+    signal_ready(outdir, pid)
+
     import numpy as np
 
     from blit.parallel.mesh import make_mesh

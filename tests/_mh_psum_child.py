@@ -17,6 +17,7 @@ import sys
 
 def main() -> None:
     pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    outdir = sys.argv[4] if len(sys.argv) > 4 else None
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -30,6 +31,12 @@ def main() -> None:
         cpu_collectives="gloo",
     )
     assert active and jax.process_count() == nproc
+
+    if outdir:
+        # Bring-up barrier marker (tests/test_multiprocess.py).
+        from blit.testing import signal_ready
+
+        signal_ready(outdir, pid)
 
     import numpy as np
 

@@ -46,6 +46,11 @@ def main() -> None:
     )
     assert active and jax.process_count() == nproc
 
+    # Bring-up barrier marker (tests/test_multiprocess.py).
+    from blit.testing import signal_ready
+
+    signal_ready(outdir, pid)
+
     from blit.parallel import mesh as M
     from blit.parallel.scan import reduce_scan_mesh_to_files
     from blit.testing import synth_raw

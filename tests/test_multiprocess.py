@@ -54,6 +54,101 @@ def _golden(tmp_path):
     return hdr, np.asarray(out)
 
 
+# Deflaked pod execution (ISSUE 8 satellite).  The old shape gave each
+# child ONE 240 s budget covering BOTH distributed bring-up (coordinator
+# + gloo handshakes — legitimately slow on saturated CI runners) and the
+# actual reduction, and papered over the races with a blanket
+# @pytest.mark.flaky(reruns=1).  Now the child drops a readiness marker
+# the moment init_multihost returns (blit.testing.signal_ready), and the
+# parent runs TWO separately-budgeted phases:
+#
+#   1. readiness barrier — wait for every child's marker.  Bring-up load
+#      spikes extend only this phase; a child that DIES during bring-up
+#      fails immediately with its stderr (no timeout wait).
+#   2. work — communicate() from the barrier, so the reduction gets its
+#      full budget regardless of how slow bring-up was.
+#
+# Budgets are env-tunable for slower rigs (BLIT_POD_READY_TIMEOUT_S /
+# BLIT_POD_WORK_TIMEOUT_S); a deterministic failure still fails — only
+# the load-dependent bring-up race is absorbed, so the rerun marker (and
+# its plugin dependency) is gone.  Defaults are sized so the designed
+# worst case (barrier + both sequential communicates; in practice the
+# children run concurrently, so the second communicate returns almost
+# immediately after the first) stays inside the tier-1 job's outer
+# 870 s wall with room for the rest of the suite — the per-test
+# backstop below must be REACHABLE in CI, not just on paper.
+_READY_TIMEOUT_S = float(os.environ.get("BLIT_POD_READY_TIMEOUT_S", 240))
+_WORK_TIMEOUT_S = float(os.environ.get("BLIT_POD_WORK_TIMEOUT_S", 240))
+# Per-test backstop (pytest-timeout, inert without the plugin): sized
+# ABOVE the phases' own worst case — barrier + two sequential
+# communicate() budgets — so the tailored failure messages and child
+# kill/cleanup above always run first, and raising the env budgets on a
+# slow rig raises this backstop with them.
+_TEST_TIMEOUT_S = int(_READY_TIMEOUT_S + 2 * _WORK_TIMEOUT_S + 60)
+
+
+def _child_err(outdir, pid):
+    try:
+        with open(os.path.join(outdir, f"child{pid}.err")) as f:
+            return f.read()
+    except OSError:
+        return "<no stderr captured>"
+
+
+def _kill_pod(procs):
+    """Kill AND reap every child: without the wait() a killed child
+    stays a zombie for the rest of the pytest session (and its
+    ResourceWarning noise lands in the very CI logs the deflake is
+    meant to keep readable)."""
+    for q in procs:
+        q.kill()
+    for q in procs:
+        try:
+            q.wait(timeout=10)
+        except Exception:  # noqa: BLE001 — already failing the test
+            pass
+
+
+def _await_ready(procs, outdir, timeout_s):
+    """Block until every child wrote its readiness marker; fail with the
+    dead child's stderr (from its redirect file) if one exits during
+    bring-up."""
+    import time
+
+    deadline = time.monotonic() + timeout_s
+    pending = {pid: os.path.join(outdir, f".ready{pid}")
+               for pid in range(len(procs))}
+    while pending:
+        for pid in list(pending):
+            if os.path.exists(pending[pid]):
+                del pending[pid]
+                continue
+            p = procs[pid]
+            if p.poll() is not None:
+                if p.returncode == 0 and os.path.exists(pending[pid]):
+                    # Fast child: it wrote its marker and exited cleanly
+                    # between our marker check and poll() — ready, not
+                    # dead.  (Without this recheck, a sub-second child
+                    # reintroduces exactly the flake this barrier fixes.)
+                    del pending[pid]
+                    continue
+                _kill_pod(procs)
+                pytest.fail(
+                    f"pod child {pid} died during bring-up "
+                    f"(rc={p.returncode}):\n"
+                    f"{_child_err(outdir, pid)[-3000:]}"
+                )
+        if pending and time.monotonic() > deadline:
+            _kill_pod(procs)
+            pytest.fail(
+                f"pod children {sorted(pending)} not ready within "
+                f"{timeout_s:.0f}s (coordinator / gloo bring-up stall; "
+                "raise BLIT_POD_READY_TIMEOUT_S on slower rigs)"
+            )
+        if pending:
+            time.sleep(0.1)
+
+
 def _run_pod(outdir, extra_args=(), child=CHILD):
     port = _free_port()
     env = dict(os.environ)
@@ -64,35 +159,50 @@ def _run_pod(outdir, extra_args=(), child=CHILD):
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
 
-    procs = [
-        subprocess.Popen(
-            [sys.executable, child, str(pid), "2", str(port), outdir,
-             *extra_args],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-            text=True,
-        )
-        for pid in range(2)
-    ]
-    outs = []
-    for p in procs:
-        try:
-            out, err = p.communicate(timeout=240)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            pytest.fail("pod child timed out (coordinator / gloo stall)")
-        outs.append((p.returncode, out, err))
-    return outs
+    # Child output goes to FILES, not pipes: the readiness barrier waits
+    # up to _READY_TIMEOUT_S without reading child output, and a chatty
+    # distributed bring-up (gloo retries, XLA logging under CI load) can
+    # fill a ~64 KiB pipe and deadlock the child BEFORE it signals ready
+    # — the exact wedge this barrier exists to absorb.
+    procs, logs = [], []
+    try:
+        for pid in range(2):
+            fo = open(os.path.join(outdir, f"child{pid}.out"), "w+")
+            fe = open(os.path.join(outdir, f"child{pid}.err"), "w+")
+            logs.append((fo, fe))
+            procs.append(subprocess.Popen(
+                [sys.executable, child, str(pid), "2", str(port), outdir,
+                 *extra_args],
+                env=env, stdout=fo, stderr=fe, text=True,
+            ))
+        _await_ready(procs, outdir, _READY_TIMEOUT_S)
+        outs = []
+        for p, (fo, fe) in zip(procs, logs):
+            try:
+                p.communicate(timeout=_WORK_TIMEOUT_S)  # output is on disk
+            except subprocess.TimeoutExpired:
+                _kill_pod(procs)
+                pytest.fail("pod child hung AFTER distributed bring-up "
+                            "completed (collective deadlock?)")
+            finally:
+                for f in (fo, fe):
+                    f.flush()
+                    f.seek(0)
+            outs.append((p.returncode, fo.read(), fe.read()))
+        return outs
+    finally:
+        # Every exit path — barrier pytest.fail, communicate timeout,
+        # happy return — closes the redirect files exactly once.
+        for fo, fe in logs:
+            for f in (fo, fe):
+                try:
+                    f.close()
+                except OSError:
+                    pass
 
 
-@pytest.mark.flaky(reruns=1)
+@pytest.mark.timeout(_TEST_TIMEOUT_S)
 def test_two_process_pod_matches_single_process(tmp_path):
-    # ISSUE 5 satellite: this pod test is known to stall under load (the
-    # localhost coordinator / gloo bring-up races the 240 s child budget
-    # on saturated runners) — ONE auto-rerun via pytest-rerunfailures,
-    # scoped to this test only, absorbs the transient without masking a
-    # real regression (a deterministic failure still fails both runs).
-    # The marker is inert where the plugin isn't installed.
     outdir = str(tmp_path / "pod")
     os.makedirs(outdir)
     outs = _run_pod(outdir)
@@ -134,6 +244,7 @@ def test_two_process_pod_matches_single_process(tmp_path):
             assert r["foff"] == pytest.approx(ghdr["foff"])
 
 
+@pytest.mark.timeout(_TEST_TIMEOUT_S)
 def test_pod_player_failure_raises_on_every_process(tmp_path):
     # One player's file missing on its owning host: the owner AND the peer
     # must both raise promptly (symmetric agreement), not error-vs-hang.
@@ -147,6 +258,7 @@ def test_pod_player_failure_raises_on_every_process(tmp_path):
         )
 
 
+@pytest.mark.timeout(_TEST_TIMEOUT_S)
 def test_two_process_psum_products_match_golden(tmp_path):
     # VERDICT r3 item 6: the psum collectives (beamform config 4, FX
     # correlator config 5) executed under jax.distributed with 2 gloo
@@ -160,6 +272,7 @@ def test_two_process_psum_products_match_golden(tmp_path):
         )
 
 
+@pytest.mark.timeout(_TEST_TIMEOUT_S)
 def test_two_process_resumable_mesh_writer(tmp_path):
     # The resume restart offset is agreed POD-WIDE (window-aligned MIN over
     # every process's cursors) — this runs crash → cursors → resume →

@@ -1,0 +1,233 @@
+"""Compression-aware readback narrowing (blit/ops/narrow.py) and the
+pinned host staging pool (blit/hostmem.py) — ISSUE 8 tentpole b/c.
+
+The load-bearing pins: device-side quantization is BITWISE identical to
+the host rule (that is what lets nbits<32 products narrow before D2H by
+default), async and sync quantized products are byte-identical files,
+and resume under a changed quantization starts fresh instead of
+splicing.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from blit import hostmem  # noqa: E402
+from blit.ops.narrow import narrow_device, narrow_host  # noqa: E402
+from blit.pipeline import RawReducer  # noqa: E402
+from blit.testing import synth_raw  # noqa: E402
+
+
+class TestNarrowRule:
+    @pytest.mark.parametrize("nbits", [8, 16])
+    def test_device_matches_host_bitwise(self, nbits):
+        rng = np.random.default_rng(7)
+        x = (rng.normal(100.0, 40.0, size=(64, 2, 257))
+             .astype(np.float32))
+        # Include exact halves (round-half-even territory), the range
+        # edges, and clipped extremes.
+        x[0, 0, :8] = [0.5, 1.5, 2.5, -3.0, 254.5, 255.5, 1e9, -1e9]
+        host = narrow_host(x, nbits, scale=0.5, offset=2.0)
+        dev = np.asarray(narrow_device(
+            jax.numpy.asarray(x), nbits, scale=0.5, offset=2.0))
+        assert host.dtype == dev.dtype
+        np.testing.assert_array_equal(host, dev)
+
+    def test_nbits32_is_identity(self):
+        x = np.arange(6, dtype=np.float32).reshape(2, 1, 3)
+        assert narrow_host(x, 32) is not None
+        np.testing.assert_array_equal(narrow_host(x, 32), x)
+        np.testing.assert_array_equal(
+            np.asarray(narrow_device(jax.numpy.asarray(x), 32)), x)
+
+    def test_bad_nbits_rejected(self):
+        with pytest.raises(ValueError, match="nbits"):
+            narrow_host(np.zeros(1, np.float32), 4)
+        with pytest.raises(ValueError, match="nbits"):
+            RawReducer(nfft=64, nbits=12)
+
+
+class TestQuantizedProducts:
+    def _raw(self, tmp_path):
+        p = str(tmp_path / "q.raw")
+        synth_raw(p, nblocks=2, obsnchan=2, ntime_per_block=2048,
+                  tone_chan=1)
+        return p
+
+    @pytest.mark.parametrize("nbits", [8, 16])
+    def test_async_equals_sync_bytes(self, tmp_path, nbits):
+        # THE tentpole-c acceptance: the async plane narrows ON DEVICE
+        # before D2H, the sync path narrows on the host — same file.
+        p = self._raw(tmp_path)
+        kw = dict(nfft=64, nint=2, chunk_frames=4, nbits=nbits,
+                  quant_scale=0.05, quant_offset=1.0)
+        a, s = str(tmp_path / "a.fil"), str(tmp_path / "s.fil")
+        RawReducer(**kw).reduce_to_file(p, a)
+        RawReducer(async_output=False, **kw).reduce_to_file(p, s)
+        with open(a, "rb") as fa, open(s, "rb") as fs:
+            assert fa.read() == fs.read()
+        from blit.io.sigproc import read_fil_data
+
+        hdr, data = read_fil_data(a)
+        assert hdr["nbits"] == nbits
+        assert np.asarray(data).dtype == (np.uint8 if nbits == 8
+                                          else np.uint16)
+        assert np.asarray(data).any()  # the tone quantizes above zero
+
+    def test_narrow_product_is_smaller(self, tmp_path):
+        p = self._raw(tmp_path)
+        f32 = str(tmp_path / "f.fil")
+        q8 = str(tmp_path / "q.fil")
+        RawReducer(nfft=64, nint=2, chunk_frames=4).reduce_to_file(p, f32)
+        RawReducer(nfft=64, nint=2, chunk_frames=4, nbits=8,
+                   quant_scale=0.05).reduce_to_file(p, q8)
+        # Same spectra count, ~1/4 the payload (header bytes differ).
+        assert os.path.getsize(q8) < os.path.getsize(f32) / 3
+
+    def test_resume_replay_byte_identical(self, tmp_path):
+        # Crash after the first slabs, resume, and the finished product
+        # matches an uninterrupted run byte for byte (the skip-frames
+        # replay re-quantizes identically).
+        from blit.pipeline import ReductionCursor
+
+        p = self._raw(tmp_path)
+        kw = dict(nfft=64, nint=2, chunk_frames=4, nbits=8,
+                  quant_scale=0.05)
+        whole = str(tmp_path / "whole.fil")
+        RawReducer(**kw).reduce_to_file(p, whole)
+
+        out = str(tmp_path / "r.fil")
+        red = RawReducer(**kw)
+        hdr = red.reduce_resumable(p, out)
+        assert hdr["nsamps"] > 0
+        # Simulate a crash that kept a durable prefix: truncate to half
+        # the rows and restore a cursor claiming them.
+        from blit.io.sigproc import read_fil_header
+
+        fhdr, off = read_fil_header(out)
+        half = fhdr["nsamps"] // 2
+        with open(out, "r+b") as f:
+            f.truncate(off + half * fhdr["nchans"] * fhdr["nifs"] * 1)
+        cur = ReductionCursor(
+            p, 64, 4, 2, "I", half * 2, raw_size=os.path.getsize(p),
+            raw_mtime_ns=os.stat(p).st_mtime_ns, nbits=8, quant_scale=0.05,
+        )
+        cur.save(out)
+        RawReducer(**kw).reduce_resumable(p, out)
+        with open(out, "rb") as fr, open(whole, "rb") as fw:
+            assert fr.read() == fw.read()
+
+    def test_resume_quant_mismatch_starts_fresh(self, tmp_path):
+        # A cursor written under different quantization must NOT be
+        # resumed into (splicing 8-bit and f32 spectra would corrupt the
+        # product silently).
+        from blit.pipeline import ReductionCursor
+
+        p = self._raw(tmp_path)
+        out = str(tmp_path / "m.fil")
+        red8 = RawReducer(nfft=64, nint=2, chunk_frames=4, nbits=8,
+                          quant_scale=0.05)
+        cur = ReductionCursor(
+            p, 64, 4, 2, "I", 4, raw_size=os.path.getsize(p),
+            raw_mtime_ns=os.stat(p).st_mtime_ns, nbits=32,
+        )
+        assert not cur.matches(red8, p)  # the identity guard itself
+
+    def test_h5_rejects_quantization(self, tmp_path):
+        p = self._raw(tmp_path)
+        red = RawReducer(nfft=64, nint=2, nbits=8)
+        with pytest.raises(ValueError, match="FBH5"):
+            red.reduce_to_file(p, str(tmp_path / "x.h5"))
+        with pytest.raises(ValueError, match="FBH5"):
+            red.reduce_resumable(p, str(tmp_path / "y.h5"))
+
+    def test_stream_and_reduce_honor_nbits(self, tmp_path):
+        # The nbits knob applies UNIFORMLY: stream()/reduce() return the
+        # same quantized narrow product reduce_to_file writes — a reducer
+        # constructed with nbits=8 never silently hands back float32.
+        from blit.io.guppi import GuppiRaw
+        from blit.io.sigproc import read_fil_data
+
+        p = self._raw(tmp_path)
+        kw = dict(nfft=64, nint=2, chunk_frames=4, nbits=8,
+                  quant_scale=0.05)
+        slabs = list(RawReducer(**kw).stream(GuppiRaw(p)))
+        assert slabs and all(s.dtype == np.uint8 for s in slabs)
+        sync = list(RawReducer(async_output=False, **kw).stream(
+            GuppiRaw(p)))
+        np.testing.assert_array_equal(np.concatenate(slabs, axis=0),
+                                      np.concatenate(sync, axis=0))
+        hdr, data = RawReducer(**kw).reduce(p)
+        assert hdr["nbits"] == 8 and data.dtype == np.uint8
+        out = str(tmp_path / "m.fil")
+        RawReducer(**kw).reduce_to_file(p, out)
+        fhdr, fdata = read_fil_data(out)
+        np.testing.assert_array_equal(
+            data.reshape(fdata.shape), np.asarray(fdata))
+
+
+class TestHostStaging:
+    def test_aligned_empty_alignment(self):
+        for shape in [(3, 5), (1,), (17, 33, 2)]:
+            a = hostmem.aligned_empty(shape, np.int8)
+            assert a.ctypes.data % 4096 == 0
+            assert a.shape == tuple(shape) and a.flags.c_contiguous
+
+    def test_pool_reuses_exact_shape(self):
+        pool = hostmem.SlabPool(budget_bytes=1 << 20)
+        a = pool.take((64, 4), np.int8)
+        marker = a.ctypes.data
+        pool.give(a)
+        b = pool.take((64, 4), np.int8)
+        assert b.ctypes.data == marker  # the same faulted storage
+        assert pool.take((64, 8), np.int8).ctypes.data != marker
+        assert pool.stats()["reused"] == 1
+
+    def test_pool_budget_evicts(self):
+        pool = hostmem.SlabPool(budget_bytes=1000)
+        big = pool.take((2000,), np.int8)
+        pool.give(big)  # over budget → dropped
+        assert pool.stats()["free_bytes"] == 0
+        small = [pool.take((400,), np.int8) for _ in range(3)]
+        for s in small:
+            pool.give(s)
+        st = pool.stats()
+        assert st["free_bytes"] <= 1000 and st["dropped"] >= 1
+
+    def test_eviction_counts_agree_with_telemetry(self):
+        # stats()["dropped"] and the staging.drop timeline counter must
+        # agree, eviction path included (review fix).
+        from blit import observability
+
+        tl = observability.process_timeline()
+        before = tl.stages["staging.drop"].calls
+        pool = hostmem.SlabPool(budget_bytes=1000)
+        held = [pool.take((400,), np.int8) for _ in range(4)]
+        for h in held:  # 4 x 400 B into a 1000 B budget → evictions
+            pool.give(h)
+        assert pool.stats()["dropped"] > 0
+        assert tl.stages["staging.drop"].calls - before == \
+            pool.stats()["dropped"]
+
+    def test_zero_budget_disables(self):
+        pool = hostmem.SlabPool(budget_bytes=0)
+        a = pool.take((16,), np.int8)
+        pool.give(a)
+        assert pool.stats()["free_bytes"] == 0
+
+    def test_reduction_reuses_staging_across_reducers(self, tmp_path):
+        # The cross-stream contract: a SECOND reducer of the same shape
+        # (the serve-layer pattern) stages through the first one's
+        # retired slabs instead of allocating.
+        p = str(tmp_path / "s.raw")
+        synth_raw(p, nblocks=2, obsnchan=2, ntime_per_block=2048)
+        pool = hostmem.slab_pool()
+        RawReducer(nfft=64, nint=2, chunk_frames=4).reduce_to_file(
+            p, str(tmp_path / "one.fil"))
+        reused0 = pool.stats()["reused"]
+        RawReducer(nfft=64, nint=2, chunk_frames=4).reduce_to_file(
+            p, str(tmp_path / "two.fil"))
+        assert pool.stats()["reused"] > reused0

@@ -94,6 +94,29 @@ class TestOutputRotation:
         finally:
             rot.close()
 
+    def test_late_release_retires_slab_to_staging_pool(self):
+        # A slab still held by a consumer when close() sweeps the ring
+        # (the AsyncSink write-behind tail pattern) must retire to the
+        # process staging pool on release — not feed the GC and make the
+        # next stream re-pay allocation + first-touch faults.
+        from blit import hostmem
+
+        pool = hostmem.slab_pool()
+        rot = OutputRotation(depth=2, reuse=True)
+        held = []
+        try:
+            for slab in rot.put(jnp.full((4099,), 7.0)):
+                held.append(slab)
+            for slab in rot.drain():
+                held.append(slab)
+        finally:
+            rot.close()
+        assert held  # the ring path ran (CPU fetch copies into a slab)
+        before = pool.stats()["free_bytes"]
+        for slab in held:
+            slab.release()
+        assert pool.stats()["free_bytes"] >= before + 4099 * 4
+
     def test_on_consumed_fires_before_emission(self):
         events = []
         rot = OutputRotation(depth=1)
@@ -487,3 +510,35 @@ class TestIngestBenchCLI:
         assert a["stages"]["write"]["bytes"] == a["stages"]["readback"]["bytes"] > 0
         assert a["product_bytes"] == legs[False]["product_bytes"]
         assert "async_speedup" in rep
+        # ISSUE 8 satellites: stage TAILS from the telemetry hists (not
+        # just means), the byte-identity bit, and tuning provenance in
+        # the ingest_config block.
+        q = a["stage_quantiles"]
+        for h in ("out.chunk_latency_s", "out.readback_lag_s",
+                  "out.write_s"):
+            assert {"p50", "p99", "n"} <= set(q[h]), h
+        assert rep["products_identical"] is True
+        tuning = rep["ingest_config"]["tuning"]
+        assert set(tuning["sources"]) == {"chunk_frames",
+                                          "prefetch_depth", "out_depth"}
+
+    def test_ingest_bench_narrowed_product(self, capsys):
+        # --nbits 8: the async leg narrows ON DEVICE before D2H; the
+        # sync leg quantizes host-side — products must stay identical
+        # and 4x smaller than f32.
+        import json
+
+        from blit.__main__ import main
+
+        rc = main(["ingest-bench", "--nfft", "128", "--chunks", "2",
+                   "--chunk-frames", "4", "--nchan", "2", "--blocks", "2",
+                   "--sync-compare", "--nbits", "8",
+                   "--quant-scale", "0.05"])
+        assert rc == 0
+        rep = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert rep["products_identical"] is True
+        a = {leg["async_output"]: leg for leg in rep["legs"]}[True]
+        # The readback stage moved the NARROW bytes (uint8 product).
+        assert a["stages"]["readback"]["bytes"] == \
+            a["stages"]["write"]["bytes"]
+        assert a["stages"]["write"]["bytes"] < rep["file_bytes"]
