@@ -255,6 +255,10 @@ def run_single(config_name: str) -> None:
         result.update(_run_collectives())
     except Exception as e:  # noqa: BLE001 — secondary metric must not kill the line
         result["collectives_error"] = f"{type(e).__name__}: {e}"
+    try:
+        result.update(_run_mesh_collectives())
+    except Exception as e:  # noqa: BLE001 — secondary metric must not kill the line
+        result["mesh_collectives_error"] = f"{type(e).__name__}: {e}"
     # Telemetry surfacing (ISSUE 5): span/flight-event counts plus any
     # process-timeline histograms ride the bench line, and the full fleet
     # report lands wherever BLIT_TELEMETRY_OUT points (the CI-artifact
@@ -848,6 +852,148 @@ def _run_collectives() -> dict:
         # RAM-backed fixtures must not outlive the run, success or
         # not — repeated failed attempts would exhaust /dev/shm.
         shutil.rmtree(tmp, ignore_errors=True)
+
+def _run_mesh_collectives() -> dict:
+    """The sharded plane's collective probe (ISSUE 9): pure all_gather
+    and psum programs over whatever mesh THIS rig's devices form,
+    reporting per-chip vs aggregate ICI GB/s and the ``mesh.gather_s`` /
+    ``mesh.psum_s`` p50/p99 quantiles through the PR 5 histogram
+    machinery — the same hists the sharded scan's probe windows feed, so
+    a bench artifact and a production scan report read alike.
+
+    On a 1-chip rig the gather leg degenerates (no ICI; recorded as
+    such) — the multi-device numbers come from pods and from the CI
+    virtual mesh.  The provenance block also records the (2, n/2)
+    band-axis dryrun parity result (``__graft_entry__.dryrun_multichip``
+    run on a virtual CPU pod in a SUBPROCESS, so the real backend held
+    by this process is never clobbered)."""
+    import os
+    import subprocess
+
+    import jax
+
+    from blit.observability import Timeline
+    from blit.parallel import mesh as M
+
+    devs = jax.devices()
+    n = len(devs)
+    nbank = max(k for k in (1, 2, 4, 8) if k <= n)
+    mesh = M.make_mesh(1, nbank, devices=devs)
+    tl = Timeline()
+    rng = np.random.default_rng(7)
+    K = 24
+    out = {"mesh_collectives": {}}
+    cfg = out["mesh_collectives"]
+
+    # all_gather leg: a bank-sharded filterbank block through the scan
+    # plane's own stitch program (blit/parallel/mesh.stitch_despike).
+    t, F = 16, nbank * 4096
+    x = jax.device_put(
+        rng.standard_normal((1, t, 1, F)).astype(np.float32),
+        M.sharding_for(mesh, "filterbank_sharded"),
+    )
+    jax.block_until_ready(x)
+    shard_bytes = x.nbytes // nbank
+    ici = M.gather_ici_bytes(shard_bytes, nbank)
+    y = M.stitch_despike(x, mesh=mesh, despike_nfpc=0)  # compile
+    jax.block_until_ready(y)
+    for _ in range(K):
+        t0 = time.perf_counter()
+        y = M.stitch_despike(x, mesh=mesh, despike_nfpc=0)
+        jax.block_until_ready(y)
+        M.record_ici(tl, "gather", ici, time.perf_counter() - t0)
+    g = tl.hists["mesh.gather_s"]
+    p50 = g.percentile(50) or float("inf")
+    cfg["gather"] = {
+        "mesh": [1, nbank],
+        "operand_bytes": x.nbytes,
+        "ici_bytes_per_chip": ici,
+        "per_chip_gbps": round(ici / p50 / 1e9, 3),
+        "aggregate_gbps": round(ici * nbank / p50 / 1e9, 3),
+    }
+
+    # psum leg: the correlator's closing collective — a band-axis psum
+    # over a (2, n/2) mesh when the rig has one.
+    if n >= 4 and n % 2 == 0:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from blit.compat import shard_map
+
+        mesh2 = M.make_mesh(2, n // 2, devices=devs)
+        rows = 64
+        v = jax.device_put(
+            rng.standard_normal((2 * rows, 4096)).astype(np.float32),
+            NamedSharding(mesh2, P("band", None)),
+        )
+        jax.block_until_ready(v)
+
+        @jax.jit
+        def pfn(v):
+            return shard_map(
+                lambda b: jax.lax.psum(b, "band"), mesh=mesh2,
+                in_specs=P("band", None), out_specs=P(None, None),
+                check_vma=False,
+            )(v)
+
+        w = pfn(v)
+        jax.block_until_ready(w)
+        per_chip = v.nbytes // 2  # the per-chip band block
+        ici_p = M.psum_ici_bytes(per_chip, 2)
+        for _ in range(K):
+            t0 = time.perf_counter()
+            w = pfn(v)
+            jax.block_until_ready(w)
+            M.record_ici(tl, "psum", ici_p, time.perf_counter() - t0)
+        p = tl.hists["mesh.psum_s"]
+        p50p = p.percentile(50) or float("inf")
+        cfg["psum"] = {
+            "mesh": [2, n // 2],
+            "operand_bytes": per_chip,
+            "ici_bytes_per_chip": ici_p,
+            "per_chip_gbps": round(ici_p / p50p / 1e9, 3),
+            "aggregate_gbps": round(ici_p * n / p50p / 1e9, 3),
+        }
+    else:
+        cfg["psum"] = {"skipped": f"{n} device(s): no (2, n/2) band axis"}
+
+    # The p50/p99 tails (MESH_HISTS) + per-collective ICI byte hists —
+    # the acceptance's provenance block.
+    cfg["quantiles"] = tl.hist_quantiles()
+    cfg["ici_stage"] = {
+        "calls": tl.stages["mesh.ici"].calls,
+        "bytes": tl.stages["mesh.ici"].bytes,
+    }
+
+    # Band-axis dryrun parity (the (2, n/2) pass of dryrun_multichip,
+    # incl. the sharded-vs-per-chip byte-identity assertion) on a
+    # subprocess virtual CPU pod.
+    try:
+        entry = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "__graft_entry__.py")
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   XLA_FLAGS="--xla_force_host_platform_device_count=8")
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import sys; sys.path.insert(0, sys.argv[1]); "
+             "from __graft_entry__ import dryrun_multichip; "
+             "import json; print(json.dumps(dryrun_multichip(8)))",
+             os.path.dirname(entry)],
+            env=env, capture_output=True, text=True, timeout=900,
+        )
+        lines = proc.stdout.strip().splitlines()
+        if proc.returncode == 0 and lines:
+            cfg["band_axis_dryrun"] = json.loads(lines[-1])
+        else:
+            tail = proc.stderr.strip().splitlines()
+            cfg["band_axis_dryrun"] = {
+                "ok": False, "error": (tail[-1] if tail else
+                                       f"rc={proc.returncode}"),
+            }
+    except Exception as e:  # noqa: BLE001 — provenance must not kill the leg
+        cfg["band_axis_dryrun"] = {"ok": False,
+                                   "error": f"{type(e).__name__}: {e}"}
+    return out
+
 
 def _run_config1() -> dict:
     """BASELINE config 1: single-bank ``0002.h5`` read → integrated power

@@ -187,10 +187,20 @@ def _cmd_stream(args: argparse.Namespace) -> int:
 
 
 def _cmd_scan(args: argparse.Namespace) -> int:
-    from blit.config import default_window_frames
+    from blit.config import default_window_frames, mesh_defaults
     from blit.inventory import get_inventory
     from blit.observability import Timeline
-    from blit.parallel.scan import reduce_scan_mesh_to_files
+    from blit.parallel.scan import (
+        reduce_scan_mesh_to_files,
+        reduce_scan_pool_to_files,
+    )
+
+    mdef = mesh_defaults()
+    # Parallelism selection (ISSUE 9): --sharded = the fully-threaded
+    # sharded reduction plane; --pool = the per-player pool fallback /
+    # byte-identity oracle; neither = SiteConfig/BLIT_MESH_SHARDED picks
+    # between the sharded plane and the serial mesh window loop.
+    sharded = args.sharded or (mdef["sharded"] and not args.pool)
 
     invs = [get_inventory(args.file_re or r"\.raw$", root=args.root)]
     # The EFFECTIVE window (library default + nint rounding), so the
@@ -199,6 +209,8 @@ def _cmd_scan(args: argparse.Namespace) -> int:
     # frames-per-dispatch is the same quantity `blit tune` converged as
     # chunk_frames, so the profile transfers.
     tuning = {"source": "explicit"}
+    depths = {"prefetch_depth": mdef["prefetch_depth"],
+              "out_depth": mdef["out_depth"]}
     if args.window_frames is None:
         # Resolve through a throwaway probe reducer so the profile key
         # comes out of EXACTLY the code path reduce/serve/stream use —
@@ -211,6 +223,13 @@ def _cmd_scan(args: argparse.Namespace) -> int:
                            stokes=args.stokes, fqav_by=args.fqav,
                            dtype=args.dtype)
         probe_prov = probe.tuning_provenance()
+        # The sharded plane's rotation depths resolve from the SAME
+        # profile (unless BLIT_MESH_PREFETCH/BLIT_MESH_OUT_DEPTH pinned
+        # them) — the "tuning profiles resolved per-rig as today" rule.
+        for knob in ("prefetch_depth", "out_depth"):
+            if (depths[knob] is None
+                    and probe_prov["sources"][knob] == "profile"):
+                depths[knob] = getattr(probe, knob)
         if probe_prov["sources"]["chunk_frames"] == "profile":
             wf = probe.chunk_frames
             prov = probe_prov["profile"]
@@ -241,9 +260,81 @@ def _cmd_scan(args: argparse.Namespace) -> int:
         wf = args.window_frames
     wf = max((wf // args.nint) * args.nint, args.nint)
     tl = Timeline()
-    written = reduce_scan_mesh_to_files(
-        args.session,
-        args.scan,
+    parallel = "sharded" if sharded else ("pool" if args.pool else "mesh")
+    if args.search:
+        if args.resume:
+            # Whole-scan search has no resume machinery (the per-file
+            # `blit search --resume` path does) — refuse loudly rather
+            # than silently re-running a crashed pod search from frame 0.
+            raise SystemExit(
+                "--resume is not supported with scan --search; re-run "
+                "fresh, or use `blit search --resume` per player"
+            )
+        # Filterbank-product knobs the search planes cannot honor
+        # (DedopplerReducer searches Stokes-I unaveraged spectra; .hits
+        # are JSON lines): refuse loudly, like --resume above, instead
+        # of writing a product the flags pretend to have shaped.
+        if args.stokes != "I":
+            raise SystemExit("--stokes is not supported with --search "
+                             "(drift search runs on Stokes I)")
+        if args.fqav != 1:
+            raise SystemExit("--fqav is not supported with --search "
+                             "(the drift transform needs full-resolution "
+                             "fine channels)")
+        if args.compression is not None:
+            raise SystemExit("--compression applies to .h5 filterbank "
+                             "products, not .hits")
+        # Effective window: whole search windows (window_spectra * nint
+        # frames each), resolved through the SAME reducer knob path both
+        # search planes use — so the stats line reports what actually
+        # executed and the two paths dispatch at identical shapes.
+        from blit.search import DedopplerReducer
+
+        probe = DedopplerReducer(
+            nfft=args.nfft, nint=args.nint, dtype=args.dtype,
+            window_spectra=args.window_spectra,
+        )
+        unit = probe.window_spectra * args.nint
+        wf = max((wf // unit) * unit, unit)
+        if args.pool:
+            if args.max_frames is not None:
+                # DedopplerReducer searches whole recordings; silently
+                # dropping the cap would also break the sharded-vs-pool
+                # byte-identity diff this path exists to provide.
+                raise SystemExit(
+                    "--max-frames is not supported with --pool --search "
+                    "(the per-player reducers search whole recordings)"
+                )
+            from blit.observability import profile_trace
+
+            with profile_trace(args.trace_logdir):
+                written = _pool_scan_search(args, invs, wf, tl)
+        else:
+            # The sharded search plane: every chip searches its own
+            # frequency slice; per-player .hits products (ISSUE 9).
+            from blit.parallel.sharded import search_scan_sharded_to_files
+
+            parallel = "sharded"
+            written = search_scan_sharded_to_files(
+                args.session, args.scan, inventories=invs,
+                out_dir=args.output_dir, nfft=args.nfft, nint=args.nint,
+                dtype=args.dtype, window_spectra=args.window_spectra,
+                top_k=args.top_k, snr_threshold=args.snr,
+                max_drift_bins=args.max_drift_bins, kernel=args.kernel,
+                interpret=args.interpret, window_frames=wf,
+                max_frames=args.max_frames, timeline=tl,
+                trace_logdir=args.trace_logdir, **depths,
+            )
+        for player, (path, hdr) in sorted(written.items()):
+            print(json.dumps({
+                "player": list(player), "output": path,
+                "windows": hdr.get("search_windows"),
+                "nchans": hdr.get("nchans"),
+            }))
+        print(json.dumps({"window_frames": wf, "parallel": parallel,
+                          "tuning": tuning, "stages": tl.report()}))
+        return 0
+    kw = dict(
         inventories=invs,
         out_dir=args.output_dir,
         nfft=args.nfft,
@@ -254,11 +345,35 @@ def _cmd_scan(args: argparse.Namespace) -> int:
         window_frames=wf,
         max_frames=args.max_frames,
         compression=args.compression,
-        resume=args.resume,
         dtype=args.dtype,
         timeline=tl,
-        trace_logdir=args.trace_logdir,
     )
+    if args.pool:
+        if args.resume:
+            raise SystemExit(
+                "--resume applies to the mesh/sharded paths; the pool "
+                "fallback re-runs whole per-bank reductions"
+            )
+        # The pool oracle honors --trace-logdir like every other scan
+        # path — wrapped here because the library call itself takes no
+        # trace knob (it is plain host-looped reducers).
+        from blit.observability import profile_trace
+
+        with profile_trace(args.trace_logdir):
+            written = reduce_scan_pool_to_files(args.session, args.scan,
+                                                **kw)
+    elif sharded:
+        from blit.parallel.sharded import reduce_scan_sharded_to_files
+
+        written = reduce_scan_sharded_to_files(
+            args.session, args.scan, resume=args.resume,
+            trace_logdir=args.trace_logdir, **depths, **kw,
+        )
+    else:
+        written = reduce_scan_mesh_to_files(
+            args.session, args.scan, resume=args.resume,
+            trace_logdir=args.trace_logdir, **kw,
+        )
     for band, (path, hdr) in sorted(written.items()):
         print(
             json.dumps(
@@ -273,9 +388,50 @@ def _cmd_scan(args: argparse.Namespace) -> int:
             )
         )
     # Per-stage throughput (read/device/readback/write), like blit reduce.
-    print(json.dumps({"window_frames": wf, "tuning": tuning,
-                      "stages": tl.report()}))
+    print(json.dumps({"window_frames": wf, "parallel": parallel,
+                      "tuning": tuning, "stages": tl.report()}))
     return 0
+
+
+def _pool_scan_search(args: argparse.Namespace, invs, wf: int, tl) -> dict:
+    """The pool-path whole-scan search fallback/oracle: one
+    :class:`blit.search.DedopplerReducer` per (band, bank) player, each
+    writing its own ``.hits`` — the per-player twin of
+    ``search_scan_sharded_to_files`` (same dispatch shapes via
+    ``chunk_frames=window_frames``, so the products are byte-identical;
+    tests/test_sharded.py).
+
+    Oracle scope: each reducer searches its player's WHOLE recording,
+    so byte-identity to the sharded path holds when the players share a
+    common whole-window span (the recorded case).  Ragged recordings
+    diverge by design — the sharded path truncates every player to the
+    pod-agreed minimum span; ``--max-frames`` is rejected here for the
+    same reason (the caller raises before dispatch)."""
+    import os
+
+    from blit.inventory import scan_grid
+    from blit.search import DedopplerReducer
+
+    band_ids, _, grid = scan_grid(invs, args.session, args.scan)
+    # ``wf`` arrives already rounded to whole search windows by
+    # _cmd_scan (the sharded path's own rounding), so chunk_frames
+    # dispatches at the identical shapes byte-identity assumes.
+    written = {}
+    for b, row in enumerate(grid):
+        for k, rp in enumerate(row):
+            red = DedopplerReducer(
+                nfft=args.nfft, nint=args.nint, dtype=args.dtype,
+                window_spectra=args.window_spectra, top_k=args.top_k,
+                snr_threshold=args.snr,
+                max_drift_bins=args.max_drift_bins, kernel=args.kernel,
+                interpret=args.interpret, chunk_frames=wf, timeline=tl,
+            )
+            out = os.path.join(
+                args.output_dir, f"band{band_ids[b]}bank{k}.hits"
+            )
+            hdr = red.search_to_file(rp, out)
+            written[(band_ids[b], k)] = (out, hdr)
+    return written
 
 
 def _cmd_inventory(args: argparse.Namespace) -> int:
@@ -983,6 +1139,38 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="crash-resumable streaming (cursor sidecar per "
                          "band; .fil and .h5, incl. --compression "
                          "bitshuffle)")
+    par = ps.add_mutually_exclusive_group()
+    par.add_argument("--sharded", action="store_true",
+                     help="the sharded reduction plane (ISSUE 9): "
+                          "pipelined per-shard chunk feeds, async "
+                          "addressable-shard readback and write-behind "
+                          "sinks around the same one-program SPMD "
+                          "reduction; byte-identical products (default: "
+                          "SiteConfig/BLIT_MESH_SHARDED)")
+    par.add_argument("--pool", action="store_true",
+                     help="the pool-path fallback: one RawReducer per "
+                          "(band, bank) player + main-process stitch — "
+                          "the reference's shape, and the sharded "
+                          "plane's byte-identity oracle")
+    ps.add_argument("--search", action="store_true",
+                    help="write per-player .hits drift-search products "
+                         "instead of per-band filterbanks (each chip "
+                         "searches its own frequency slice)")
+    ps.add_argument("--window-spectra", type=int, default=None,
+                    help="search window (with --search; default "
+                         "SiteConfig/BLIT_SEARCH_WINDOW)")
+    ps.add_argument("--snr", type=float, default=None,
+                    help="search SNR threshold (with --search)")
+    ps.add_argument("--top-k", type=int, default=None,
+                    help="hits kept per band per window (with --search)")
+    ps.add_argument("--max-drift-bins", type=int, default=None,
+                    help="clamp the searched drift range (with --search)")
+    ps.add_argument("--kernel", default="auto",
+                    choices=["auto", "reference", "pallas"],
+                    help="drift-transform backend (with --search)")
+    ps.add_argument("--interpret", action="store_true",
+                    help="pallas interpreter mode (CPU smoke; with "
+                         "--search)")
     ps.set_defaults(fn=_cmd_scan)
 
     pi = sub.add_parser("inventory", help="crawl a data tree")

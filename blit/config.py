@@ -142,6 +142,23 @@ class SiteConfig:
     # wins; 0 disables pooling).
     tune_dir: Optional[str] = None
     staging_pool_bytes: Optional[int] = None
+    # Sharded reduction plane (blit/parallel/sharded.py; ISSUE 9).
+    # mesh_sharded makes `blit scan` default to the fully-threaded
+    # sharded plane (pipelined per-shard feeds + async addressable-shard
+    # readback) instead of the serial window loop; the pool path stays
+    # the explicit fallback either way.  mesh_probe_windows is how many
+    # leading windows of a sharded scan time the stitch collective
+    # honestly (they serialize compute vs gather to sample
+    # ``mesh.gather_s``; 0 disables the probe — steady-state windows
+    # only account ICI bytes).  mesh_prefetch_depth / mesh_out_depth
+    # size the feed rotation and readback/write-behind planes (None =
+    # the ingest-plane defaults, or this rig's tuning profile via the
+    # CLI).  Per-process overrides: BLIT_MESH_SHARDED / BLIT_MESH_PROBE
+    # / BLIT_MESH_PREFETCH / BLIT_MESH_OUT_DEPTH (:func:`mesh_defaults`).
+    mesh_sharded: bool = False
+    mesh_probe_windows: int = 2
+    mesh_prefetch_depth: Optional[int] = None
+    mesh_out_depth: Optional[int] = None
 
     def io_retry_policy(self):
         """The :class:`blit.faults.RetryPolicy` for worker-side file I/O —
@@ -238,6 +255,34 @@ def stream_defaults(config: SiteConfig = DEFAULT) -> Dict:
             "BLIT_STREAM_IDLE_TIMEOUT", config.stream_idle_timeout_s),
         "stall_timeout_s": opt_s(
             "BLIT_STREAM_STALL_TIMEOUT", config.stream_stall_timeout_s),
+    }
+
+
+def mesh_defaults(config: SiteConfig = DEFAULT) -> Dict:
+    """The effective sharded-plane knob set (ISSUE 9): ``config``'s
+    values with per-process ``BLIT_MESH_*`` environment overrides
+    applied — the :func:`search_defaults` pattern, resolved at scan
+    construction so tests and deployments retune per run."""
+
+    def opt_int(env: str, fallback: Optional[int]) -> Optional[int]:
+        v = os.environ.get(env)
+        if v is None or v == "":
+            return fallback
+        i = int(v)
+        return None if i < 0 else i
+
+    sharded = os.environ.get("BLIT_MESH_SHARDED")
+    return {
+        "sharded": (
+            config.mesh_sharded if sharded is None
+            else sharded not in ("", "0", "false", "False")
+        ),
+        "probe_windows": int(os.environ.get(
+            "BLIT_MESH_PROBE", config.mesh_probe_windows)),
+        "prefetch_depth": opt_int(
+            "BLIT_MESH_PREFETCH", config.mesh_prefetch_depth),
+        "out_depth": opt_int(
+            "BLIT_MESH_OUT_DEPTH", config.mesh_out_depth),
     }
 
 

@@ -53,6 +53,35 @@ def reduce_scan_mesh_to_files(*args, **kw):
 
     return _impl(*args, **kw)
 
+
+def reduce_scan_sharded_to_files(*args, **kw):
+    """The sharded reduction plane (ISSUE 9): one scan as one SPMD
+    program, threaded end to end through the ingest/output planes; see
+    :func:`blit.parallel.sharded.reduce_scan_sharded_to_files`.  Lazy
+    wrapper, as :func:`load_scan_mesh`."""
+    from blit.parallel.sharded import reduce_scan_sharded_to_files as _impl
+
+    return _impl(*args, **kw)
+
+
+def reduce_scan_pool_to_files(*args, **kw):
+    """The pool-path whole-scan fallback and byte-identity oracle (one
+    ``RawReducer`` per player + main-process ``vcat`` stitch); see
+    :func:`blit.parallel.scan.reduce_scan_pool_to_files`."""
+    from blit.parallel.scan import reduce_scan_pool_to_files as _impl
+
+    return _impl(*args, **kw)
+
+
+def search_scan_sharded_to_files(*args, **kw):
+    """Sharded whole-scan drift search: each chip searches its own
+    frequency slice, per-player ``.hits`` products byte-identical to the
+    pool path's; see
+    :func:`blit.parallel.sharded.search_scan_sharded_to_files`."""
+    from blit.parallel.sharded import search_scan_sharded_to_files as _impl
+
+    return _impl(*args, **kw)
+
 log = logging.getLogger("blit.gbt")
 
 Idxs = Tuple

@@ -192,7 +192,7 @@ class OutputRotation:
                         self._eof = True
                         self._cv.notify_all()
                     return
-                out, nbytes, payload, on_consumed, t_enq = item
+                out, nbytes, payload, on_consumed, fetch, t_enq = item
                 t_got = time.perf_counter()
                 # Queue-side lag distribution (ISSUE 5 tentpole #2): how
                 # long dispatches wait before the readback thread reaches
@@ -212,6 +212,17 @@ class OutputRotation:
                     # Output ready ⇒ inputs consumed: ingest slots refill.
                     on_consumed()
                 self._wd.beat()
+                if not fetch:
+                    # Sync-only put (the sharded plane's non-writer pod
+                    # processes, ISSUE 9): the dispatch had to be waited
+                    # out — it pins feed slots and orders the stream —
+                    # but nothing reads its bytes host-side, so no
+                    # device→host fetch happens and no slab is emitted.
+                    del out, item
+                    with self._cv:
+                        self._pending -= 1
+                        self._cv.notify_all()
+                    continue
                 recycled = False
                 with self._tl.stage("readback"):
                     host = np.asarray(out)
@@ -322,16 +333,18 @@ class OutputRotation:
                            active=self._thread.is_alive())
 
     def put(self, out, *, nbytes: Optional[int] = None, payload=None,
-            on_consumed: Optional[Callable[[], None]] = None
-            ) -> List[OutputSlab]:
+            on_consumed: Optional[Callable[[], None]] = None,
+            fetch: bool = True) -> List[OutputSlab]:
         """Enqueue an async-dispatched device array for readback; return
         the slabs completed so far (possibly empty), blocking while
         ``depth`` outputs are pending.  ``nbytes`` (the dispatch's input
-        bytes) lands on the ``device`` stage; omitted ⇒ byte-free."""
+        bytes) lands on the ``device`` stage; omitted ⇒ byte-free.
+        ``fetch=False`` syncs the dispatch (and fires ``on_consumed``)
+        without a device→host fetch — no slab is ever emitted for it."""
         with self._cv:
             self._check()
             self._pending += 1
-        self._in.put((out, nbytes, payload, on_consumed,
+        self._in.put((out, nbytes, payload, on_consumed, fetch,
                       time.perf_counter()))
         ready: List[OutputSlab] = []
         with self._cv:

@@ -329,6 +329,7 @@ def beamform_stream(
     """
     from blit.observability import Timeline
     from blit.outplane import OutputRotation
+    from blit.parallel.mesh import psum_ici_bytes, record_ici
 
     tl = timeline if timeline is not None else Timeline()
     # depth=2 reproduces the old lag-1 overlap: put(window w) returns
@@ -342,6 +343,10 @@ def beamform_stream(
                          stall_timeout_s=stall_timeout_s)
     from blit import observability
 
+    axis_size = mesh.shape[axis]
+    nbeam = np.shape(weights[0] if isinstance(weights, tuple) else weights)[
+        1 if layout == "chan" else 0
+    ]
     try:
         with observability.span("beamform.stream"):
             for win in feed:
@@ -362,6 +367,20 @@ def beamform_stream(
                         win.arrays, weights, mesh=mesh, axis=axis, nint=nint,
                         detect=True, layout=layout,
                     )
+                if axis_size > 1:
+                    # The fused per-window psum moves the partial beam
+                    # planes (pre-detect, full time extent) over ICI —
+                    # account it per window (mesh.ici stage + byte hist;
+                    # its latency is only separable on the bench's pure
+                    # collective leg, MESH_HISTS).
+                    vr0 = win.arrays[0]
+                    nchan_w = (vr0.shape[0] if layout == "chan"
+                               else vr0.shape[1])
+                    plane = (2 * nbeam * nchan_w * win.ntime
+                             * (vr0.shape[-1 if layout != "chan" else 2])
+                             * vr0.dtype.itemsize)
+                    record_ici(tl, "psum",
+                               psum_ici_bytes(plane, axis_size))
                 for slab in rot.put(out, on_consumed=win.release):
                     yield slab.data
             for slab in rot.drain():
@@ -392,9 +411,13 @@ def beamform_accumulate(
     from blit import observability
     from blit.observability import Timeline
     from blit.outplane import FoldInFlight
+    from blit.parallel.mesh import ShardedAccumulator
 
     tl = timeline if timeline is not None else Timeline()
-    acc = None
+    # The total-power accumulator carries its partition rule (ISSUE 9):
+    # psum output is replicated ("beamform_acc"), and the donated add
+    # below preserves that — ShardedAccumulator asserts it per fold.
+    acc = ShardedAccumulator(mesh, "beamform_acc")
     flight = FoldInFlight(tl, depth=1)
     add = _jax.jit(lambda a, p: a + p, donate_argnums=0)
     with observability.span("beamform.accumulate"):
@@ -411,16 +434,19 @@ def beamform_accumulate(
                     win.arrays, weights, mesh=mesh, axis=axis,
                     nint=win.ntime, detect=True, layout=layout,
                 )
-                acc = p if acc is None else add(acc, p)
+                if acc.value is None:
+                    acc.init(p)
+                else:
+                    acc.fold(add, p)
             flight.admit(win, p)
-        if acc is None:
+        if acc.value is None:
             raise ValueError("beamform_accumulate: feed yielded no windows")
         with tl.stage("device", byte_free=True):
-            acc.block_until_ready()
+            acc.value.block_until_ready()
         # The terminal sync above proved every fold complete — release the
         # tail without a second wait.
         flight.drain(synced=True)
-    return acc
+    return acc.value
 
 
 def antenna_sharding(mesh: Mesh, axis: str = ANT_AXIS_DEFAULT) -> NamedSharding:

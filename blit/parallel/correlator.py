@@ -30,6 +30,7 @@ two-HBM-passes saving the filterbank path uses (DESIGN.md §2).
 from __future__ import annotations
 
 import functools
+import time
 from typing import Iterable, Optional
 
 from blit.ops.dft import ComplexOrPlanar, Planar, as_planar
@@ -309,13 +310,20 @@ def visibility_sharding(mesh: Mesh) -> NamedSharding:
 # ``correlate(acc_frames=...)``'s in-step fold, which is what makes the
 # float32 stream byte-identical to the one-shot call).
 
+def _acc_rule(vis_layout: str) -> str:
+    """The accumulator's :data:`blit.parallel.mesh.PARTITION_RULES` role."""
+    return "vis_acc_packed" if vis_layout == "packed" else "vis_acc_standard"
+
+
 def _acc_spec(vis_layout: str) -> P:
     """PartitionSpec of the band-sharded partial-visibility accumulator:
     standard ``(nband, nant, nant, nchan, nfft, npol, npol)`` / packed
-    ``(nband, nchan, nfft, nant, npol, nant, npol)``."""
-    if vis_layout == "packed":
-        return P(BAND_AXIS, BANK_AXIS)
-    return P(BAND_AXIS, None, None, BANK_AXIS)
+    ``(nband, nchan, nfft, nant, npol, nant, npol)`` — resolved through
+    the sharded plane's partition-rule registry (ISSUE 9: the fold
+    accumulator carries its spec; dispatch and readback cannot drift)."""
+    from blit.parallel.mesh import partition_rule
+
+    return partition_rule(_acc_rule(vis_layout))
 
 
 _SPEC_V = P(None, BANK_AXIS, BAND_AXIS)
@@ -357,6 +365,15 @@ def _accum_vis(accr, acci, vr, vi, h, *, mesh: Mesh, vis_layout: str):
         step, mesh=mesh, in_specs=(spec, spec, _SPEC_V, _SPEC_V, P()),
         out_specs=(spec, spec), check_vma=False,
     )(accr, acci, vr, vi, h)
+
+
+def _fold_vis(value, vr, vi, h, *, mesh: Mesh, vis_layout: str):
+    """The :class:`blit.parallel.mesh.ShardedAccumulator` fold adapter:
+    ``value`` is the live ``(accr, acci)`` pair, donated through
+    :func:`_accum_vis` (its ``donate_argnums``)."""
+    accr, acci = value
+    return _accum_vis(accr, acci, vr, vi, h, mesh=mesh,
+                      vis_layout=vis_layout)
 
 
 @functools.partial(jax.jit, static_argnames=("mesh", "vis_layout"))
@@ -420,11 +437,19 @@ def correlate_stream(
             f"coeffs shape {coeffs.shape} != (ntap={ntap}, nfft={nfft})"
         )
     from blit.outplane import FoldInFlight
+    from blit.parallel.mesh import (
+        ShardedAccumulator,
+        psum_ici_bytes,
+        record_ici,
+    )
 
     from blit import observability
 
     tl = timeline if timeline is not None else Timeline()
-    accr = acci = None
+    # The fold accumulator CARRIES its partition rule (ISSUE 9): the
+    # band-sharded partial visibilities and the spec that shards them
+    # travel together, donated window to window.
+    acc = ShardedAccumulator(mesh, _acc_rule(vis_layout))
     flight = FoldInFlight(tl, depth=1)
     with observability.span("correlate.stream"):
         for win in feed:
@@ -444,23 +469,47 @@ def correlate_stream(
             flight.make_room()
             with observability.span("correlate.window", i=win.index), \
                     tl.stage("dispatch", byte_free=True):
-                if accr is None:
-                    accr, acci = _window_vis(
+                if acc.value is None:
+                    acc.init(_window_vis(
                         vr, vi, coeffs, mesh=mesh, vis_layout=vis_layout
-                    )
+                    ))
                 else:
-                    accr, acci = _accum_vis(
-                        accr, acci, vr, vi, coeffs,
-                        mesh=mesh, vis_layout=vis_layout,
-                    )
-            flight.admit(win, accr)
-        if accr is None:
+                    acc.fold(_fold_vis, vr, vi, coeffs,
+                             mesh=mesh, vis_layout=vis_layout)
+            flight.admit(win, acc.value[0])
+        if acc.value is None:
             raise ValueError("correlate_stream: feed yielded no windows")
+        nband = mesh.shape[BAND_AXIS]
         with tl.stage("device", byte_free=True):
-            visr, visi = _finish_vis(
-                accr, acci, mesh=mesh, vis_layout=vis_layout
-            )
-            jax.block_until_ready((visr, visi))
+            if nband > 1:
+                # Warm-up dispatch: this is _finish_vis's first call of
+                # the stream, so a timed cold call would sample
+                # trace+XLA compile, not the collective (the PR 8
+                # OnlineTuner chunk-1 lesson; .lower().compile() does
+                # NOT warm the jit call cache on supported jax).  The
+                # warm-up also syncs every fold, so the timed
+                # re-dispatch below is the psum program alone — the
+                # honest mesh.psum_s sample, one extra end-of-stream
+                # collective, never per-window.
+                jax.block_until_ready(_finish_vis(
+                    *acc.value, mesh=mesh, vis_layout=vis_layout
+                ))
+                t0 = time.perf_counter()
+                visr, visi = _finish_vis(
+                    *acc.value, mesh=mesh, vis_layout=vis_layout
+                )
+                jax.block_until_ready((visr, visi))
+                psum_s = time.perf_counter() - t0
+            else:
+                # Single-band mesh: the psum is the identity, there is
+                # no ICI sample to take — one dispatch, no warm-up.
+                visr, visi = _finish_vis(
+                    *acc.value, mesh=mesh, vis_layout=vis_layout
+                )
+                jax.block_until_ready((visr, visi))
+        if nband > 1:
+            per_chip = sum(a.nbytes for a in acc.value) // mesh.size
+            record_ici(tl, "psum", psum_ici_bytes(per_chip, nband), psum_s)
         # The finish fetch just proved every fold complete — release the last
         # window without the old second sync of the accumulator (ISSUE 4:
         # "double sync today").

@@ -17,7 +17,7 @@ per-chip body is exactly the single-chip reduction from
 from __future__ import annotations
 
 import functools
-from typing import Optional
+from typing import Dict, Optional, Union
 
 import numpy as np
 
@@ -31,6 +31,98 @@ from blit.ops.despike import despike
 
 BAND_AXIS = "band"
 BANK_AXIS = "bank"
+
+# -- partition rules ---------------------------------------------------------
+#
+# Every array role of the sharded reduction plane (ISSUE 9) names its
+# PartitionSpec HERE, in one registry, instead of each call site hand-rolling
+# specs: the feed (`put_local_shards`), the fold accumulators
+# (:class:`ShardedAccumulator` — beamform/correlate carry these specs across
+# donated windows), and the product/readback side all resolve through
+# `partition_rule`, so a layout change is one edit and the specs cannot
+# drift between the dispatch and the readback that interprets its shards.
+
+PARTITION_RULES: Dict[str, P] = {
+    # Ingest: int8 voltage blocks (nband, nbank, nchan, ntime, npol, 2) —
+    # one (band, bank) block per chip.
+    "voltages": P(BAND_AXIS, BANK_AXIS),
+    # Replicated small operands (PFB coefficient banks, thresholds).
+    "replicated": P(),
+    # Products (nband, ntime, nif, nchans): channel axis sharded over bank
+    # (pre-stitch), or replicated across each band row (post-stitch).
+    "filterbank_sharded": P(BAND_AXIS, None, None, BANK_AXIS),
+    "filterbank_stitched": P(BAND_AXIS, None, None, None),
+    # Packed per-chip hit tables (nband, nbank, nbands, k, 4) — the search
+    # plane's device-side extraction output (blit/ops/pallas_dedoppler).
+    "packed_hits": P(BAND_AXIS, BANK_AXIS),
+    # Fold accumulators (donated across windows).  The beamform total-power
+    # accumulator is psum output, replicated; the correlator's partial
+    # visibilities stay band-sharded (leading band block axis) with the
+    # channel axis over bank — standard (nband, a, b, c, f, p, q) vs packed
+    # (nband, c, f, a, p, b, q) layouts.
+    "beamform_acc": P(),
+    "vis_acc_standard": P(BAND_AXIS, None, None, BANK_AXIS),
+    "vis_acc_packed": P(BAND_AXIS, BANK_AXIS),
+}
+
+# The collective-latency histograms of the sharded plane (ISSUE 9): every
+# honestly-timeable collective observes into these Timeline hists, and the
+# bench's mesh_collectives leg reports their p50/p99.
+MESH_HISTS = ("mesh.gather_s", "mesh.psum_s")
+
+
+def partition_rule(role: Union[str, P]) -> P:
+    """The registry's PartitionSpec for ``role`` (a spec passes through —
+    callers that already hold one can use the same entry points)."""
+    if isinstance(role, str):
+        try:
+            return PARTITION_RULES[role]
+        except KeyError:
+            raise KeyError(
+                f"unknown partition rule {role!r}; known roles: "
+                f"{sorted(PARTITION_RULES)}"
+            ) from None
+    return role
+
+
+def sharding_for(mesh: Mesh, role: Union[str, P]) -> NamedSharding:
+    """``NamedSharding`` of ``role`` on ``mesh`` (partition-rule-driven —
+    the one way array placement is spelled on the sharded plane)."""
+    return NamedSharding(mesh, partition_rule(role))
+
+
+def gather_ici_bytes(shard_bytes: int, axis_size: int) -> int:
+    """Per-chip ICI bytes one ``all_gather`` moves: each chip RECEIVES
+    every other shard of its axis row — ``(axis_size - 1) * shard_bytes``
+    (ring schedule; send volume is the same, counted once)."""
+    return max(0, axis_size - 1) * shard_bytes
+
+
+def psum_ici_bytes(nbytes: int, axis_size: int) -> int:
+    """Per-chip ICI bytes one ``psum`` moves for an ``nbytes`` operand:
+    ring all-reduce = reduce-scatter + all-gather, ``2 * (n-1)/n *
+    nbytes`` received per chip."""
+    if axis_size <= 1:
+        return 0
+    return int(2 * (axis_size - 1) * nbytes // axis_size)
+
+
+def record_ici(timeline, collective: str, nbytes: int,
+               seconds: Optional[float] = None) -> None:
+    """Account one collective on a Timeline (ISSUE 9 telemetry contract):
+    cumulative per-chip ICI traffic on the ``mesh.ici`` stage, a
+    per-dispatch byte histogram (``mesh.<collective>_ici_bytes``), and —
+    when the caller could honestly time the collective's own dispatch
+    (a probe window, the correlator's closing psum, the bench's pure
+    collective legs) — a latency sample into ``mesh.<collective>_s``
+    (:data:`MESH_HISTS`).  ``collective`` is ``"gather"`` or ``"psum"``."""
+    s = timeline.stages["mesh.ici"]
+    s.calls += 1
+    s.bytes += int(nbytes)
+    timeline.observe(f"mesh.{collective}_ici_bytes", float(nbytes))
+    if seconds is not None:
+        s.seconds += seconds
+        timeline.observe(f"mesh.{collective}_s", seconds)
 
 
 def make_mesh(
@@ -56,16 +148,16 @@ def make_mesh(
 def voltage_sharding(mesh: Mesh) -> NamedSharding:
     """Sharding for a global voltage array ``(nband, nbank, nchan, ntime,
     npol, 2)``: one (band, bank) block per chip."""
-    return NamedSharding(mesh, P(BAND_AXIS, BANK_AXIS))
+    return sharding_for(mesh, "voltages")
 
 
 def filterbank_sharding(mesh: Mesh, stitched: bool) -> NamedSharding:
     """Sharding of the reduced product ``(nband, ntime, nif, nchans)``:
     channel axis sharded over ``bank`` (unstitched) or replicated across the
     bank axis (stitched)."""
-    if stitched:
-        return NamedSharding(mesh, P(BAND_AXIS, None, None, None))
-    return NamedSharding(mesh, P(BAND_AXIS, None, None, BANK_AXIS))
+    return sharding_for(
+        mesh, "filterbank_stitched" if stitched else "filterbank_sharded"
+    )
 
 
 @functools.partial(
@@ -156,16 +248,37 @@ def stitch_bands(x: jax.Array, mesh: Mesh) -> jax.Array:
     nchans_sharded)`` into a contiguous band, replicated across each band's
     banks.  Equivalent to ``band_reduce(..., stitch=True)``'s epilogue; kept
     separate so host-read products (e.g. FBH5 slabs loaded via
-    :mod:`blit.gbt`) can be stitched on-device too."""
+    :mod:`blit.gbt`) can be stitched on-device too.  The despike-free case
+    of :func:`stitch_despike` — ONE stitch program, not two to keep in
+    sync."""
+    return stitch_despike(x, mesh=mesh, despike_nfpc=0)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "despike_nfpc"))
+def stitch_despike(x: jax.Array, *, mesh: Mesh, despike_nfpc: int = 0):
+    """The sharded plane's standalone stitch program: gather a bank-sharded
+    filterbank ``(nband, t, nif, nchans_sharded)`` into a contiguous band
+    (replicated across each band's banks) and optionally repair the
+    per-coarse-channel DC spikes post-stitch.
+
+    This is ``band_reduce(stitch=True)``'s epilogue split into its own
+    dispatch so the window loop can TIME the all_gather honestly
+    (``mesh.gather_s``) and account its ICI bytes per window — the
+    per-chip channelize and the collective land in separate programs,
+    with the per-chip program bit-identical to the pool path's
+    single-chip ``channelize`` (tests/test_sharded.py pins this)."""
 
     def gather(blk):
-        return jax.lax.all_gather(blk, BANK_AXIS, axis=3, tiled=True)
+        out = jax.lax.all_gather(blk, BANK_AXIS, axis=3, tiled=True)
+        if despike_nfpc >= 2:
+            out = despike(out, despike_nfpc)
+        return out
 
     return shard_map(
         gather,
         mesh=mesh,
-        in_specs=P(BAND_AXIS, None, None, BANK_AXIS),
-        out_specs=P(BAND_AXIS, None, None, None),
+        in_specs=partition_rule("filterbank_sharded"),
+        out_specs=partition_rule("filterbank_stitched"),
         check_vma=False,  # all_gather output is bank-invariant
     )(x)
 
@@ -176,3 +289,86 @@ def shard_voltages(
     """Place a host ``(nband, nbank, ...)`` voltage array onto the mesh with
     one block per chip (the host→device feed for tests and the dry run)."""
     return jax.device_put(voltages, voltage_sharding(mesh))
+
+
+def put_local_shards(
+    blocks: Dict, mesh: Mesh, global_shape, role: Union[str, P] = "voltages"
+) -> jax.Array:
+    """``jax.device_put`` with shardings, multi-host-shaped: assemble the
+    global sharded array for ``role`` from one host block per LOCALLY
+    OWNED ``(band, bank)`` player — the sharded plane's replacement for
+    the pool path's per-worker H2D scatter.
+
+    ``blocks`` maps ``(band, bank)`` to that player's host block with the
+    leading ``(1, 1, ...)`` block axes already present.  Each block goes
+    straight onto its chip and the global array is built from the
+    single-device shards, so the host never materializes the whole scan
+    and no ``device_put`` targets a non-addressable device (the
+    multi-process contract of :func:`blit.parallel.scan._feed_window`,
+    now partition-rule-driven)."""
+    shards = [
+        jax.device_put(blk, mesh.devices[b, k])
+        for (b, k), blk in sorted(blocks.items())
+    ]
+    return jax.make_array_from_single_device_arrays(
+        tuple(global_shape), sharding_for(mesh, role), shards
+    )
+
+
+class ShardedAccumulator:
+    """A windowed fold accumulator that CARRIES its partition rule
+    (ISSUE 9 tentpole): the value pytree, the mesh, and the
+    :data:`PARTITION_RULES` entry that shards it travel together, so
+    every fold dispatch and the final readback agree on placement by
+    construction.
+
+    Contract (the ``correlate_stream`` fold discipline, generalized):
+
+    - :meth:`init` installs the first window's value (already sharded by
+      the producing program — its out_specs must match this rule).
+    - :meth:`fold` applies a caller-jitted fold whose FIRST argument is
+      the current value, **donated** (``donate_argnums=0`` on the
+      caller's jit): HBM is reused in place across the whole stream and
+      the accumulator never exists twice.  The fold's out_specs must
+      preserve the rule — :meth:`fold` asserts the returned sharding
+      still matches, so a drifted spec fails loudly at the first window
+      instead of silently regathering every fold.
+    - :attr:`value` holds the live pytree; ``spec``/``sharding`` expose
+      the rule for finish programs (the correlator's closing band psum).
+    """
+
+    def __init__(self, mesh: Mesh, rule: Union[str, P]):
+        self.mesh = mesh
+        self.rule = rule
+        self.spec = partition_rule(rule)
+        self.value = None
+
+    @property
+    def sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, self.spec)
+
+    def init(self, value):
+        self.value = value
+        self._check(value)
+        return value
+
+    def fold(self, fn, *args, **kw):
+        """``value = fn(value, *args, **kw)`` — ``fn`` must donate its
+        first argument (a donated token can no longer be waited on, so
+        callers must lag-sync BEFORE the next fold, the
+        :class:`blit.outplane.FoldInFlight` rule)."""
+        if self.value is None:
+            raise RuntimeError("ShardedAccumulator.fold before init")
+        self.value = fn(self.value, *args, **kw)
+        self._check(self.value)
+        return self.value
+
+    def _check(self, value) -> None:
+        want = self.sharding
+        for leaf in jax.tree_util.tree_leaves(value):
+            got = getattr(leaf, "sharding", None)
+            if got is not None and not got.is_equivalent_to(want, leaf.ndim):
+                raise ValueError(
+                    f"accumulator sharding drifted from rule {self.rule!r}: "
+                    f"{got} != {want}"
+                )

@@ -239,12 +239,11 @@ def _feed_window(raws, local, mesh, nchan, npol, start, ntime):
     ``[start, start + ntime)`` of every player.  One bank in host memory at
     a time: each local player's block goes straight onto its chip, and the
     global array is built from the single-device shards (no whole-scan host
-    buffer, no device_put to any non-addressable device)."""
-    import jax
-
+    buffer, no device_put to any non-addressable device) — the assembly
+    itself is :func:`blit.parallel.mesh.put_local_shards`, the ONE
+    partition-rule-driven implementation the sharded plane shares."""
     nband, nbank = mesh.devices.shape
-    global_shape = (nband, nbank, nchan, ntime, npol, 2)
-    shards = []
+    blocks = {}
     for b, k in local:
         r = raws[(b, k)]
         v = _gapless(r, ntime, skip=start)
@@ -253,10 +252,9 @@ def _feed_window(raws, local, mesh, nchan, npol, start, ntime):
                 f"{r.path}: shape {v.shape} incompatible with "
                 f"(nchan={nchan}, ntime>={ntime}, npol={npol}, 2)"
             )
-        block = np.ascontiguousarray(v[None, None, :, :ntime])
-        shards.append(jax.device_put(block, mesh.devices[b, k]))
-    return jax.make_array_from_single_device_arrays(
-        global_shape, M.voltage_sharding(mesh), shards
+        blocks[(b, k)] = np.ascontiguousarray(v[None, None, :, :ntime])
+    return M.put_local_shards(
+        blocks, mesh, (nband, nbank, nchan, ntime, npol, 2)
     )
 
 
@@ -353,6 +351,189 @@ def _slab_writer(path: str, header: Dict, nif: int, nchans: int,
     from blit.io.sigproc import FilWriter
 
     return FilWriter(path, header, nif, nchans)
+
+
+def _resolve_out_paths(band_ids, nband, out_dir, out_paths, compression):
+    """Per-band product path resolution + the pre-collective compression
+    validation (shared by the sync mesh writer and the sharded plane —
+    a raise here happens on EVERY process, before any collective)."""
+    import os
+
+    if out_paths is None:
+        if out_dir is None:
+            raise ValueError("pass out_dir= or out_paths=")
+        ext = "h5" if compression else "fil"
+        out_paths = [
+            os.path.join(out_dir, f"band{band_ids[b]}.{ext}")
+            for b in range(nband)
+        ]
+    if len(out_paths) != nband:
+        raise ValueError(f"need {nband} out_paths, got {len(out_paths)}")
+    if compression is not None:
+        bad = [p for p in out_paths if not p.endswith((".h5", ".hdf5"))]
+        if bad:
+            # Validate BEFORE any collective, on every process: a raise
+            # inside the per-band writer loop would fire only on band-
+            # owning processes and leave the rest blocked in the window
+            # loop's collectives (the deadlock the caller docstrings
+            # warn about).
+            raise ValueError(
+                ".fil products are uncompressed; compression= needs .h5 "
+                f"paths, got {bad}"
+            )
+    return list(out_paths)
+
+
+def _open_band_writers(
+    mesh, raws, out_paths, *, h0, bases, per_bank, stokes,
+    nfft, ntap, nint, window, fqav_by, dtype, despike_nfpc,
+    compression, resume, wf, total,
+):
+    """The product-side prologue shared by the sync mesh writer and the
+    sharded reduction plane (blit/parallel/sharded.py): which band rows
+    THIS process persists (the bank-0 chip owner), their headers, the
+    pod-wide-agreed resume restart offset, and the opened writers.
+
+    Returns ``(mine, headers, writers, f0_start)``.  On a construction
+    failure the already-built writers are aborted (their own crash
+    contracts) before the error re-raises — callers' stream-error paths
+    only ever see fully-constructed writer sets."""
+    import os
+
+    import jax
+
+    nband, nbank = mesh.devices.shape
+    nif = STOKES_NIF[stokes]
+    nchans = nbank * per_bank
+    mine = [
+        b for b in range(nband)
+        if mesh.devices[b, 0].process_index == jax.process_index()
+    ]
+    headers: Dict[int, Dict] = {}
+    for b in mine:
+        hdr = dict(h0)
+        hdr["fch1"] = bases[b]
+        hdr["nchans"] = nchans
+        hdr["nifs"] = nif
+        headers[b] = hdr
+
+    f0_start = 0
+    cursors = {}
+    h5_chunk_rows = None
+    if resume:
+        from types import SimpleNamespace
+
+        from blit.pipeline import ReductionCursor
+
+        comp_id = compression or "none"
+        # Mesh .h5-bitshuffle products tie the writer's chunk rows to the
+        # window granularity (the pod-wide restart offset is window-
+        # aligned, and bitshuffle resume points must be chunk-aligned), so
+        # the granularity joins the resume identity: a changed
+        # --window-frames restarts fresh instead of splicing mismatched
+        # chunk grids.  .fil and plain/gzip .h5 truncate at any row.
+        wrows_ident = -1
+        if comp_id == "bitshuffle" and any(
+            p.endswith((".h5", ".hdf5")) for p in out_paths
+        ):
+            from blit.io.fbh5 import default_chunks
+
+            wrows = wf // nint
+            base = default_chunks(nif, nchans, 4, whole_spectrum=True)[0]
+            h5_chunk_rows = _bitshuffle_window_chunk_rows(base, wrows)
+            wrows_ident = wrows
+        # dtype is output-affecting (bf16 stages round differently), so
+        # it joins the resume identity like every other config knob.
+        ident = SimpleNamespace(
+            nfft=nfft, ntap=ntap, nint=nint, stokes=stokes, window=window,
+            fqav_by=fqav_by, dtype=dtype, despike_nfpc=despike_nfpc,
+        )
+        # This process's fed member files: the input identity a resume
+        # must match (a changed recording would splice different spectra).
+        members = sorted(
+            p
+            for r in raws.values()
+            for p in (getattr(r, "paths", None) or [r.path])
+        )
+        local_done = []
+        for b in mine:
+            cur = ReductionCursor.load(out_paths[b])
+            ok = (
+                cur is not None
+                and cur.matches(ident, members)
+                and cur.compression == comp_id
+                and cur.window_rows == wrows_ident
+                and os.path.exists(out_paths[b])
+            )
+            if ok and out_paths[b].endswith((".h5", ".hdf5")):
+                # Crash robustness (ADVICE r5 medium): an HDF5 target a
+                # SIGKILL left unopenable/unreadable restarts this band
+                # fresh, like an identity mismatch — the check runs
+                # BEFORE the pod-wide restart agreement, so every
+                # process agrees on the (now zero) restart offset
+                # instead of deadlocking or wedging on a raise.
+                from blit.io.fbh5 import resume_target_ok
+
+                if not resume_target_ok(
+                    out_paths[b], nif, nchans, cur.frames_done // nint
+                ):
+                    log.warning(
+                        "resume target %s is not readable as the claimed "
+                        "HDF5 product (crash-corrupted metadata?); "
+                        "discarding %d claimed frames and restarting the "
+                        "band fresh", out_paths[b], cur.frames_done,
+                    )
+                    ok = False
+            if not ok:
+                size, mtime_ns = ReductionCursor.stat_raw(members)
+                cur = ReductionCursor(
+                    members, nfft, ntap, nint, stokes, 0, window=window,
+                    raw_size=size, raw_mtime_ns=mtime_ns, fqav_by=fqav_by,
+                    dtype=dtype, despike_nfpc=despike_nfpc,
+                    compression=comp_id, window_rows=wrows_ident,
+                )
+            cursors[b] = cur
+            local_done.append(cur.frames_done if ok else 0)
+        # Pod-wide agreement: the window loop is collective-synchronized,
+        # so every process must restart at the SAME offset.  Processes
+        # owning no band rows ride a sentinel above any real count.
+        local_min = min(local_done) if local_done else 1 << 61
+        agreed = int(_gather_int64(
+            np.asarray([local_min], np.int64)
+        ).min())
+        f0_start = min((agreed // wf) * wf, total)
+
+    writers = {}
+    try:
+        for b in mine:
+            if resume and out_paths[b].endswith((".h5", ".hdf5")):
+                from blit.io.fbh5 import ResumableFBH5Writer
+
+                writers[b] = ResumableFBH5Writer(
+                    out_paths[b], headers[b], nif, nchans,
+                    f0_start // nint, nint, cursors[b],
+                    compression=compression,
+                    chunks=(
+                        (h5_chunk_rows, nif, nchans)
+                        if h5_chunk_rows else None
+                    ),
+                )
+            elif resume:
+                from blit.pipeline import ResumableFilWriter
+
+                writers[b] = ResumableFilWriter(
+                    out_paths[b], headers[b], nif, nchans,
+                    f0_start // nint, nint, cursors[b],
+                )
+            else:
+                writers[b] = _slab_writer(
+                    out_paths[b], headers[b], nif, nchans, compression
+                )
+    except BaseException:
+        for w in writers.values():
+            w.abort()
+        raise
+    return mine, headers, writers, f0_start
 
 
 def load_scan_mesh(
@@ -547,9 +728,6 @@ def reduce_scan_mesh_to_files(
     locally-fed member files; the finished product is identical to an
     uninterrupted run and the sidecars are removed on completion.
     """
-    import os
-
-    import jax
     import jax.numpy as jnp
 
     band_ids, raw_paths = _resolve_grid(raw_paths, scan, inventories)
@@ -574,163 +752,24 @@ def reduce_scan_mesh_to_files(
         window_frames = default_window_frames(nfft)
     wf = max((window_frames // nint) * nint, nint)
 
-    if out_paths is None:
-        if out_dir is None:
-            raise ValueError("pass out_dir= or out_paths=")
-        ext = "h5" if compression else "fil"
-        out_paths = [
-            os.path.join(out_dir, f"band{band_ids[b]}.{ext}")
-            for b in range(nband)
-        ]
-    if len(out_paths) != nband:
-        raise ValueError(f"need {nband} out_paths, got {len(out_paths)}")
-    if compression is not None:
-        bad = [p for p in out_paths if not p.endswith((".h5", ".hdf5"))]
-        if bad:
-            # Validate BEFORE any collective, on every process: a raise
-            # inside the per-band writer loop would fire only on band-
-            # owning processes and leave the rest blocked in the window
-            # loop's collectives (the deadlock the docstring warns about).
-            raise ValueError(
-                ".fil products are uncompressed; compression= needs .h5 "
-                f"paths, got {bad}"
-            )
+    out_paths = _resolve_out_paths(
+        band_ids, nband, out_dir, out_paths, compression
+    )
 
     h0, bases, per_bank = _scan_headers(
         raws, local, nfft=nfft, nint=nint, stokes=stokes, fqav_by=fqav_by,
     )
-    nif = STOKES_NIF[stokes]
-    nchans = nbank * per_bank
-
-    # Which band rows THIS process persists: the bank-0 chip owner (the
-    # stitched band is replicated across the row, so one writer per band).
-    mine = [
-        b for b in range(nband)
-        if mesh.devices[b, 0].process_index == jax.process_index()
-    ]
-    headers: Dict[int, Dict] = {}
-    for b in mine:
-        hdr = dict(h0)
-        hdr["fch1"] = bases[b]
-        hdr["nchans"] = nchans
-        hdr["nifs"] = nif
-        headers[b] = hdr
     coeffs = jnp.asarray(pfb_coeffs(ntap, nfft, window))
     despike_nfpc = _despike_nfpc(despike, nfft, fqav_by)
 
-    f0_start = 0
-    cursors = {}
-    if resume:
-        from types import SimpleNamespace
-
-        from blit.pipeline import ReductionCursor
-
-        comp_id = compression or "none"
-        # Mesh .h5-bitshuffle products tie the writer's chunk rows to the
-        # window granularity (the pod-wide restart offset is window-
-        # aligned, and bitshuffle resume points must be chunk-aligned), so
-        # the granularity joins the resume identity: a changed
-        # --window-frames restarts fresh instead of splicing mismatched
-        # chunk grids.  .fil and plain/gzip .h5 truncate at any row.
-        h5_chunk_rows = None
-        wrows_ident = -1
-        if comp_id == "bitshuffle" and any(
-            p.endswith((".h5", ".hdf5")) for p in out_paths
-        ):
-            from blit.io.fbh5 import default_chunks
-
-            wrows = wf // nint
-            base = default_chunks(nif, nchans, 4, whole_spectrum=True)[0]
-            h5_chunk_rows = _bitshuffle_window_chunk_rows(base, wrows)
-            wrows_ident = wrows
-        # dtype is output-affecting (bf16 stages round differently), so
-        # it joins the resume identity like every other config knob.
-        ident = SimpleNamespace(
-            nfft=nfft, ntap=ntap, nint=nint, stokes=stokes, window=window,
-            fqav_by=fqav_by, dtype=dtype, despike_nfpc=despike_nfpc,
-        )
-        # This process's fed member files: the input identity a resume
-        # must match (a changed recording would splice different spectra).
-        members = sorted(
-            p
-            for r in raws.values()
-            for p in (getattr(r, "paths", None) or [r.path])
-        )
-        local_done = []
-        for b in mine:
-            cur = ReductionCursor.load(out_paths[b])
-            ok = (
-                cur is not None
-                and cur.matches(ident, members)
-                and cur.compression == comp_id
-                and cur.window_rows == wrows_ident
-                and os.path.exists(out_paths[b])
-            )
-            if ok and out_paths[b].endswith((".h5", ".hdf5")):
-                # Crash robustness (ADVICE r5 medium): an HDF5 target a
-                # SIGKILL left unopenable/unreadable restarts this band
-                # fresh, like an identity mismatch — the check runs
-                # BEFORE the pod-wide restart agreement, so every
-                # process agrees on the (now zero) restart offset
-                # instead of deadlocking or wedging on a raise.
-                from blit.io.fbh5 import resume_target_ok
-
-                if not resume_target_ok(
-                    out_paths[b], nif, nchans, cur.frames_done // nint
-                ):
-                    log.warning(
-                        "resume target %s is not readable as the claimed "
-                        "HDF5 product (crash-corrupted metadata?); "
-                        "discarding %d claimed frames and restarting the "
-                        "band fresh", out_paths[b], cur.frames_done,
-                    )
-                    ok = False
-            if not ok:
-                size, mtime_ns = ReductionCursor.stat_raw(members)
-                cur = ReductionCursor(
-                    members, nfft, ntap, nint, stokes, 0, window=window,
-                    raw_size=size, raw_mtime_ns=mtime_ns, fqav_by=fqav_by,
-                    dtype=dtype, despike_nfpc=despike_nfpc,
-                    compression=comp_id, window_rows=wrows_ident,
-                )
-            cursors[b] = cur
-            local_done.append(cur.frames_done if ok else 0)
-        # Pod-wide agreement: the window loop is collective-synchronized,
-        # so every process must restart at the SAME offset.  Processes
-        # owning no band rows ride a sentinel above any real count.
-        local_min = min(local_done) if local_done else 1 << 61
-        agreed = int(_gather_int64(
-            np.asarray([local_min], np.int64)
-        ).min())
-        f0_start = min((agreed // wf) * wf, total)
-
-    writers = {}
+    mine, headers, writers, f0_start = _open_band_writers(
+        mesh, raws, out_paths, h0=h0, bases=bases,
+        per_bank=per_bank, stokes=stokes, nfft=nfft, ntap=ntap, nint=nint,
+        window=window, fqav_by=fqav_by, dtype=dtype,
+        despike_nfpc=despike_nfpc, compression=compression, resume=resume,
+        wf=wf, total=total,
+    )
     try:
-        for b in mine:
-            if resume and out_paths[b].endswith((".h5", ".hdf5")):
-                from blit.io.fbh5 import ResumableFBH5Writer
-
-                writers[b] = ResumableFBH5Writer(
-                    out_paths[b], headers[b], nif, nchans,
-                    f0_start // nint, nint, cursors[b],
-                    compression=compression,
-                    chunks=(
-                        (h5_chunk_rows, nif, nchans)
-                        if h5_chunk_rows else None
-                    ),
-                )
-            elif resume:
-                from blit.pipeline import ResumableFilWriter
-
-                writers[b] = ResumableFilWriter(
-                    out_paths[b], headers[b], nif, nchans,
-                    f0_start // nint, nint, cursors[b],
-                )
-            else:
-                writers[b] = _slab_writer(
-                    out_paths[b], headers[b], nif, nchans, compression
-                )
-
         from blit.observability import Timeline, profile_trace
 
         tl = timeline if timeline is not None else Timeline()
@@ -800,3 +839,152 @@ def reduce_scan_mesh_to_files(
     for b in mine:
         headers[b]["nsamps"] = done[b].nsamps
     return {band_ids[b]: (out_paths[b], headers[b]) for b in mine}
+
+
+def reduce_scan_pool_to_files(
+    raw_paths,
+    scan: Optional[str] = None,
+    *,
+    inventories=None,
+    out_dir: Optional[str] = None,
+    out_paths: Optional[Sequence[str]] = None,
+    nfft: int,
+    ntap: int = 4,
+    nint: int = 1,
+    stokes: str = "I",
+    fqav_by: int = 1,
+    fft_method: str = "auto",
+    window: str = "hamming",
+    despike: bool = True,
+    max_frames: Optional[int] = None,
+    window_frames: Optional[int] = None,
+    compression: Optional[str] = None,
+    dtype: str = "float32",
+    pool=None,
+    worker_ids: Optional[Sequence[int]] = None,
+    timeline=None,
+) -> Dict[int, Tuple[str, Dict]]:
+    """The POOL path of a whole-scan reduction — the reference's shape
+    ("64 workers doing 64 small jobs", ``loadscan``'s main-process
+    ``vcat``, src/gbt.jl:90-114) kept as the sharded plane's fallback and
+    its CORRECTNESS ORACLE (ISSUE 9): one :class:`blit.pipeline.RawReducer`
+    per (band, bank) player, fanned over a :class:`~blit.parallel.pool.
+    WorkerPool` when one is given (``pool=``/``worker_ids=``, the
+    ``gbt.reduce_raw`` discipline) or run inline, then a host-side
+    channel-axis ``vcat`` + DC despike per band and one product write.
+
+    Byte-identity contract (tests/test_sharded.py): with
+    ``window_frames`` equal to the sharded path's and a common whole-frame
+    span across players, the per-band products are BYTE-IDENTICAL to
+    ``reduce_scan_sharded_to_files`` / ``reduce_scan_mesh_to_files``
+    output — the per-bank reduction is the same jitted ``channelize`` at
+    the same dispatch shapes (``chunk_frames = window_frames``), the
+    stitch is an exact concatenation, and the despike an exact
+    neighbor-clone, on host here and over ICI there.
+
+    Bounded memory is NOT this path's goal (each band's stitched array is
+    materialized host-side, exactly like the reference); the sharded
+    plane is the production path.  Returns ``{band_id: (path, header)}``
+    for every band (this process writes them all — there is no pod here).
+    """
+    band_ids, raw_paths = _resolve_grid(raw_paths, scan, inventories)
+    nband = len(raw_paths)
+    nbank = len(raw_paths[0])
+    if any(len(row) != nbank for row in raw_paths):
+        raise ValueError("raw_paths must be rectangular (nband x nbank)")
+
+    # Open every player host-side for the span/header agreement (the pool
+    # path has no pod: one process sees every file).
+    raws = {}
+    for b in range(nband):
+        for k in range(nbank):
+            r = open_raw(raw_paths[b][k])
+            if r.nblocks == 0:
+                raise ValueError(f"empty RAW file: {r.path}")
+            raws[(b, k)] = r
+    local = sorted(raws)
+    total = min(usable_frames(_kept_samples(r), nfft, ntap, nint)
+                for r in raws.values())
+    if max_frames is not None:
+        total = min(total, (max_frames // nint) * nint)
+    if total <= 0:
+        raise ValueError("scan too short")
+    if window_frames is None:
+        from blit.config import default_window_frames
+
+        window_frames = default_window_frames(nfft)
+    wf = max((window_frames // nint) * nint, nint)
+
+    out_paths = _resolve_out_paths(
+        band_ids, nband, out_dir, out_paths, compression
+    )
+    h0, bases, per_bank = _scan_headers(
+        raws, local, nfft=nfft, nint=nint, stokes=stokes, fqav_by=fqav_by,
+    )
+    nif = STOKES_NIF[stokes]
+    nchans = nbank * per_bank
+    despike_nfpc = _despike_nfpc(despike, nfft, fqav_by)
+    rows_total = total // nint
+
+    from blit.observability import Timeline
+
+    tl = timeline if timeline is not None else Timeline()
+    red_kw = dict(
+        nfft=nfft, ntap=ntap, nint=nint, stokes=stokes, window=window,
+        fft_method=fft_method, fqav_by=fqav_by, dtype=dtype,
+        chunk_frames=wf, tune_online=False,
+    )
+
+    def reduce_bank(b, k):
+        from blit.pipeline import RawReducer
+
+        _, data = RawReducer(**red_kw).reduce(raw_paths[b][k])
+        return data
+
+    written: Dict[int, Tuple[str, Dict]] = {}
+    for b in range(nband):
+        with tl.stage("read", byte_free=True):
+            if pool is not None:
+                from blit import workers as wf_mod
+
+                wids = (list(worker_ids) if worker_ids is not None
+                        else [(b * nbank + k) % len(pool) + 1
+                              for k in range(nbank)])
+                results = pool.run_on(
+                    wids, wf_mod.reduce_raw,
+                    [(raw_paths[b][k],) for k in range(nbank)],
+                    kwargs=red_kw,
+                )
+                banks = [data for _hdr, data in results]
+            else:
+                banks = [reduce_bank(b, k) for k in range(nbank)]
+        short = [k for k, d in enumerate(banks) if d.shape[0] < rows_total]
+        if short:
+            raise ValueError(
+                f"band {band_ids[b]} banks {short} yielded fewer than the "
+                f"agreed {rows_total} spectra — players disagree on span"
+            )
+        # The main-process vcat (exact) + host despike (exact clone) —
+        # the reference's stitch, trimmed to the pod-agreed common span.
+        stitched = np.concatenate(
+            [d[:rows_total] for d in banks], axis=-1
+        )
+        if despike_nfpc >= 2:
+            from blit.ops.despike import despike as _despike
+
+            stitched = np.asarray(_despike(stitched, despike_nfpc))
+        hdr = dict(h0)
+        hdr["fch1"] = bases[b]
+        hdr["nchans"] = nchans
+        hdr["nifs"] = nif
+        w = _slab_writer(out_paths[b], hdr, nif, nchans, compression)
+        try:
+            with tl.stage("write", stitched.nbytes):
+                w.append(stitched)
+            w.close()
+        except BaseException:
+            w.abort()
+            raise
+        hdr["nsamps"] = rows_total
+        written[band_ids[b]] = (out_paths[b], hdr)
+    return written

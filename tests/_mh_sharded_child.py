@@ -1,0 +1,105 @@
+"""Child process for the 2-process SHARDED-scan pod test
+(tests/test_multiprocess.py): ``reduce_scan_sharded_to_files`` — the
+fully-threaded sharded reduction plane (per-shard pinned feeds, async
+addressable-shard readback, write-behind sinks) — executed for real
+under ``jax.distributed``, each process feeding only its own players'
+files and writing only its own band rows' products.
+
+Run as: ``python tests/_mh_sharded_child.py <pid> <nproc> <port> <outdir>``.
+
+The parent byte-compares the pod's products against the single-process
+pool-path oracle over the identical synthetic scan (same seeds) —
+the ISSUE 9 byte-identity contract, under real multi-host sharding.
+Follows the PR 8 deflake discipline: ``signal_ready`` barrier marker
+after ``init_multihost``, output to parent-redirected files.
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> None:
+    pid, nproc, port, outdir = (
+        int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4]
+    )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from blit.parallel.multihost import init_multihost, local_players
+
+    active = init_multihost(
+        coordinator_address=f"localhost:{port}",
+        num_processes=nproc,
+        process_id=pid,
+        cpu_collectives="gloo",
+    )
+    assert active and jax.process_count() == nproc
+
+    # Bring-up barrier marker (tests/test_multiprocess.py).
+    from blit.testing import signal_ready
+
+    signal_ready(outdir, pid)
+
+    from blit.observability import Timeline
+    from blit.parallel import mesh as M
+    from blit.parallel.sharded import reduce_scan_sharded_to_files
+    from blit.testing import synth_raw
+
+    NBAND, NBANK, NFFT, NINT, NCHAN = 2, 4, 32, 2, 2
+    mesh = M.make_mesh(NBAND, NBANK)
+    local = sorted(local_players(mesh))
+
+    # Write ONLY this process's players' files, into a private directory:
+    # the grid entries for non-local players name files that do not exist
+    # here, proving the sharded feed never touches them.
+    priv = os.path.join(outdir, f"proc{pid}")
+    os.makedirs(priv, exist_ok=True)
+    bank_bw = -187.5 / NBANK
+    paths = [
+        [os.path.join(priv, f"blc{b}{k}.raw") for k in range(NBANK)]
+        for b in range(NBAND)
+    ]
+    for b, k in local:
+        synth_raw(
+            paths[b][k], nblocks=2, obsnchan=NCHAN, ntime_per_block=512,
+            seed=b * 8 + k, tone_chan=k % NCHAN, obsbw=bank_bw,
+            obsfreq=8000.0 + b * 500.0 + (k + 0.5) * bank_bw,
+        )
+
+    # Shared product directory: bands are disjointly owned (the bank-0
+    # chip's process writes the row), so the two children never collide.
+    prod = os.path.join(outdir, "products")
+    os.makedirs(prod, exist_ok=True)
+    tl = Timeline()
+    written = reduce_scan_sharded_to_files(
+        paths, out_dir=prod, nfft=NFFT, nint=NINT, despike=False,
+        window_frames=4, mesh=mesh, timeline=tl,
+    )
+    assert written, "every process of this 2x4 pod owns a band row"
+    for band, (path, hdr) in written.items():
+        assert os.path.exists(path), path
+        assert hdr["nchans"] == NBANK * NCHAN * NFFT, hdr
+
+    # Every window moved ICI bytes through the cross-bank stitch.
+    assert tl.stages["mesh.ici"].calls > 0
+    assert tl.stages["mesh.ici"].bytes > 0
+
+    with open(os.path.join(outdir, f"proc{pid}.json"), "w") as f:
+        json.dump(
+            {
+                "local": [list(x) for x in local],
+                "bands": sorted(written),
+                "nsamps": {
+                    str(b): int(h["nsamps"])
+                    for b, (_, h) in written.items()
+                },
+            },
+            f,
+        )
+    print("CHILD-SHARDED-OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -27,6 +27,8 @@ NBAND, NBANK, NFFT, NINT, NCHAN = 2, 4, 32, 2, 2
 CHILD = os.path.join(os.path.dirname(__file__), "_mh_child.py")
 PSUM_CHILD = os.path.join(os.path.dirname(__file__), "_mh_psum_child.py")
 RESUME_CHILD = os.path.join(os.path.dirname(__file__), "_mh_resume_child.py")
+SHARDED_CHILD = os.path.join(os.path.dirname(__file__),
+                             "_mh_sharded_child.py")
 
 
 def _free_port() -> int:
@@ -269,6 +271,59 @@ def test_two_process_psum_products_match_golden(tmp_path):
     for rc, out, err in outs:
         assert rc == 0 and "CHILD-PSUM-OK" in out, (
             f"psum pod child failed (rc={rc}):\n{err[-3000:]}"
+        )
+
+
+@pytest.mark.timeout(_TEST_TIMEOUT_S)
+def test_two_process_sharded_scan_matches_pool_oracle(tmp_path):
+    # ISSUE 9: the fully-threaded sharded reduction plane under REAL
+    # jax.distributed — per-shard pinned feeds, addressable-shard-only
+    # readback, write-behind sinks — with each process feeding only its
+    # own players' files.  The pod's per-band .fil products must be
+    # BYTE-IDENTICAL to the single-process pool-path oracle over the
+    # identical synthetic scan (same seeds, same window_frames).
+    outdir = str(tmp_path / "podsharded")
+    os.makedirs(outdir)
+    outs = _run_pod(outdir, child=SHARDED_CHILD)
+    for rc, out, err in outs:
+        assert rc == 0 and "CHILD-SHARDED-OK" in out, (
+            f"sharded pod child failed (rc={rc}):\n{err[-3000:]}"
+        )
+
+    reports = []
+    for pid in range(2):
+        with open(os.path.join(outdir, f"proc{pid}.json")) as f:
+            reports.append(json.load(f))
+    # Disjoint band ownership covering the whole scan.
+    bands = [set(r["bands"]) for r in reports]
+    assert not (bands[0] & bands[1]) and bands[0] | bands[1] == {0, 1}
+
+    # Pool oracle: the identical scan reduced single-process.
+    from blit.parallel.scan import reduce_scan_pool_to_files
+
+    bank_bw = -187.5 / NBANK
+    paths = []
+    for b in range(NBAND):
+        row = []
+        for k in range(NBANK):
+            p = str(tmp_path / f"oracle_blc{b}{k}.raw")
+            synth_raw(p, nblocks=2, obsnchan=NCHAN, ntime_per_block=512,
+                      seed=b * 8 + k, tone_chan=k % NCHAN, obsbw=bank_bw,
+                      obsfreq=8000.0 + b * 500.0 + (k + 0.5) * bank_bw)
+            row.append(p)
+        paths.append(row)
+    gold = str(tmp_path / "oracle")
+    os.makedirs(gold)
+    gw = reduce_scan_pool_to_files(
+        paths, out_dir=gold, nfft=NFFT, nint=NINT, despike=False,
+        window_frames=4,
+    )
+    import filecmp
+
+    for band in range(NBAND):
+        pod = os.path.join(outdir, "products", f"band{band}.fil")
+        assert filecmp.cmp(pod, gw[band][0], shallow=False), (
+            f"pod band {band} product != pool oracle bytes"
         )
 
 
