@@ -112,6 +112,16 @@ def run_single(config_name: str) -> None:
     except Exception:  # noqa: BLE001 — cache is an optimization, never fatal
         pass
 
+    # Live monitoring (ISSUE 11): with BLIT_MONITOR_SPOOL / _PORT set,
+    # the bench publishes its stage/hist telemetry while it measures —
+    # `blit top` watches a long TPU bench exactly like a production run.
+    try:
+        from blit import monitor
+
+        monitor.ensure_publisher()
+    except Exception:  # noqa: BLE001 — monitoring must not kill the bench
+        pass
+
     from blit.ops.channelize import (
         channelize,
         last_kernel_plan as _last_kernel_plan,
@@ -275,6 +285,38 @@ def run_single(config_name: str) -> None:
         observability.maybe_write_report()
     except Exception as e:  # noqa: BLE001 — telemetry must not kill the line
         result["telemetry_error"] = f"{type(e).__name__}: {e}"
+    # Perf-regression self-check (ISSUE 11): with BLIT_BENCH_BASELINE_DIR
+    # pointing at the checked-in BENCH_*.json trajectory, this run diffs
+    # itself against the noise bands and records the verdict in its own
+    # line — the bench-diff gate with zero extra invocations.  Advisory
+    # here (the line must always print); CI runs `blit bench-diff` as
+    # the gating step.
+    try:
+        import glob
+        import os as _os
+
+        bdir = _os.environ.get("BLIT_BENCH_BASELINE_DIR")
+        if bdir:
+            from blit import monitor
+
+            baselines = []
+            for p in sorted(glob.glob(
+                    _os.path.join(bdir, "BENCH_*.json"))):
+                try:
+                    baselines.append(monitor.load_bench_json(p))
+                except ValueError:
+                    # A failed round with no record line thins the
+                    # trajectory; it must not break the self-check.
+                    continue
+            if baselines:
+                diff = monitor.bench_diff(result, baselines)
+                result["bench_diff"] = {
+                    "verdict": diff["verdict"],
+                    "regressed": diff["regressed"],
+                    "baselines": diff["baselines"],
+                }
+    except Exception as e:  # noqa: BLE001 — the gate must not kill the line
+        result["bench_diff_error"] = f"{type(e).__name__}: {e}"
     print(json.dumps(result))
 
 

@@ -36,6 +36,11 @@ Layer map (mirrors SURVEY.md §1, rebuilt TPU-first):
 - ``blit.observability`` — the telemetry plane: spans/tracer with fan-out
   context propagation, stage timelines + log-bucketed histograms, fleet
   telemetry harvest, and the crash/stall flight recorder.
+- ``blit.monitor``  — the live monitoring & SLO plane: the background
+  metrics publisher (interval snapshots → spool JSONL + ``/metrics``/
+  ``/healthz``/``/snapshot`` HTTP endpoint), multi-window burn-rate SLO
+  evaluation with load-shed breach actions, the ``blit top`` terminal
+  dashboard, and the ``blit bench-diff`` perf-regression gate.
 - ``blit.tune``      — the ingest autotuner: per-rig content-addressed
   tuning profiles (chunk_frames / prefetch_depth / out_depth) converged
   offline (``blit tune``) or online during the first windows of a
@@ -117,6 +122,7 @@ def __getattr__(name):
         "search",
         "stream",
         "observability",
+        "monitor",
         "tune",
         "hostmem",
     ):

@@ -47,6 +47,14 @@ Commands:
   trace-view Render a flight-recorder dump (written automatically when a
              stall watchdog trips, a breaker opens, or an agent dies)
              into a readable incident summary.
+  top        Live terminal dashboard (ISSUE 11): tail a monitor spool
+             dir or poll a publisher endpoint during an in-progress
+             reduce/scan/stream/serve — per-stage throughput, stage-tail
+             p50/p99, SLO burn, host health.  ``--once`` renders one
+             frame (tests/scripts).
+  bench-diff Compare a fresh bench.py / ingest-bench JSON against the
+             checked-in BENCH_*.json trajectory with noise bands and
+             exit 0 (pass) / 2 (regress) — the CI perf-regression gate.
 """
 
 from __future__ import annotations
@@ -136,6 +144,11 @@ def _cmd_stream(args: argparse.Namespace) -> int:
     from blit.pipeline import PRODUCT_PRESETS
     from blit.stream import FileTailSource, ReplaySource
 
+    # Live monitoring (ISSUE 11): a session that never pauses is what
+    # the monitor plane exists for — the flags start the publisher, the
+    # reducer's publishing hook streams the watermark/latency telemetry.
+    pub = _monitor_from_flags(args)
+
     if args.replay_rate is not None:
         src = ReplaySource(args.raw, rate=args.replay_rate)
     else:
@@ -182,6 +195,13 @@ def _cmd_stream(args: argparse.Namespace) -> int:
         out["masked_chunk_seqs"] = hdr["_masked_chunks"]
     if hdr.get("stream_flight_dump"):
         out["flight_dump"] = hdr["stream_flight_dump"]
+    if pub is not None:
+        pub.tick()
+        out["monitor"] = {"port": pub.port, "spool": pub.spool_path,
+                          "samples": pub.seq}
+        from blit import monitor
+
+        monitor.shutdown_publisher()
     print(json.dumps(out))
     return 0
 
@@ -552,6 +572,28 @@ def _cmd_serve_bench(args: argparse.Namespace) -> int:
         return 1 if errors else 0
 
 
+def _monitor_from_flags(args: argparse.Namespace):
+    """Start the process-wide metrics publisher from ``--monitor-*``
+    CLI flags (ISSUE 11) and install it as the singleton every
+    ``publishing`` hook resolves (:func:`blit.monitor
+    .install_publisher`) — so the reductions this command runs
+    auto-publish exactly as an env-enabled deployment would, without
+    mutating the environment.  Returns the publisher (caller shuts it
+    down) or None when no flag was given."""
+    if (getattr(args, "monitor_spool", None) is None
+            and getattr(args, "monitor_port", None) is None):
+        return None
+    from blit import monitor
+
+    pub = monitor.install_publisher(monitor.MetricsPublisher(
+        interval_s=args.monitor_interval,
+        spool_dir=args.monitor_spool,
+        port=args.monitor_port).start())
+    if pub.port is not None:
+        print(f"# monitor: {pub.url}/metrics", file=sys.stderr)
+    return pub
+
+
 def _cmd_ingest_bench(args: argparse.Namespace) -> int:
     """File→product throughput probe for the asynchronous output plane
     (ISSUE 4): reduce a synthetic RAW recording to a real on-disk product
@@ -706,6 +748,11 @@ def _cmd_ingest_bench(args: argparse.Namespace) -> int:
                        tune_online=False)
     args.chunk_frames = probe.chunk_frames
 
+    # Live monitoring (ISSUE 11): --monitor-spool / --monitor-port start
+    # the process publisher, so `blit top` (or a curl at /metrics) can
+    # watch this bench while it runs — the CI monitor smoke rides this.
+    pub = _monitor_from_flags(args)
+
     with tempfile.TemporaryDirectory(prefix="blit-ingest-bench-") as td:
         raw_path = os.path.join(td, "bench.raw")
         # File length leaves exactly the (ntap-1)*nfft PFB tail after the
@@ -776,6 +823,14 @@ def _cmd_ingest_bench(args: argparse.Namespace) -> int:
             report["spans_on_s"] = on
             report["spans_off_s"] = off
             report["span_overhead"] = round(on / max(off, 1e-9) - 1.0, 4)
+        if pub is not None:
+            pub.tick()  # a final sample so short benches always spool one
+            report["monitor"] = {"port": pub.port,
+                                 "spool": pub.spool_path,
+                                 "samples": pub.seq}
+            from blit import monitor
+
+            monitor.shutdown_publisher()
         print(json.dumps(report))
     return 0
 
@@ -899,6 +954,26 @@ def _cmd_telemetry(args: argparse.Namespace) -> int:
 
     from blit import observability
 
+    if args.watch is not None and not args.demo:
+        # Poor-man's live mode (ISSUE 11 satellite): periodic re-harvest
+        # + re-render on `blit top`'s refresh path (monitor.watch_loop —
+        # same ANSI frame loop, same cadence semantics).
+        from blit import monitor
+
+        def frame() -> str:
+            if args.from_file:
+                with open(args.from_file) as f:
+                    rep = _json.load(f)
+            else:
+                rep = observability.local_fleet_report()
+            if args.format == "prom":
+                return observability.render_prometheus(rep)
+            if args.format == "json":
+                return _json.dumps(rep)
+            return observability.render_fleet_text(rep)
+
+        monitor.watch_loop(frame, args.watch, count=args.iterations)
+        return 0
     if args.from_file:
         with open(args.from_file) as f:
             report = _json.load(f)
@@ -939,6 +1014,78 @@ def _cmd_telemetry(args: argparse.Namespace) -> int:
     else:
         print(observability.render_fleet_text(report))
     return 0
+
+
+def _cmd_top(args: argparse.Namespace) -> int:
+    """``blit top`` (ISSUE 11 tentpole): the live terminal dashboard.
+    ``--spool DIR`` tails the per-process monitor spool (merging a pod's
+    processes through ``merge_fleet``); ``--url`` polls one publisher's
+    ``/snapshot`` endpoint.  Refreshes every ``--interval`` seconds with
+    an ANSI clear; ``--once`` renders a single frame with no clear."""
+    from blit import monitor, observability
+
+    def fetch() -> str:
+        if args.url:
+            import urllib.request
+
+            with urllib.request.urlopen(
+                    args.url.rstrip("/") + "/snapshot", timeout=10) as r:
+                sample = json.load(r)
+            report = observability.merge_fleet([sample])
+            samples = [sample]
+        else:
+            report, samples = monitor.merge_spool(args.spool)
+        return monitor.render_top(report, samples)
+
+    if args.once:
+        print(fetch())
+        return 0
+    monitor.watch_loop(fetch, args.interval, count=args.iterations)
+    return 0
+
+
+def _cmd_bench_diff(args: argparse.Namespace) -> int:
+    """``blit bench-diff`` (ISSUE 11 tentpole): the perf-regression
+    gate.  Loads the fresh record and the baseline trajectory (explicit
+    ``--baseline`` files and/or every ``BENCH_*.json`` under
+    ``--baseline-dir``, the fresh file itself excluded), compares every
+    shared higher-is-better metric against the trajectory's noise band,
+    and exits 0 on pass / 2 on regress."""
+    import os
+
+    from blit import monitor
+
+    baselines = []
+    if args.baseline_dir:
+        import glob
+
+        fresh_real = os.path.realpath(args.fresh)
+        for p in sorted(glob.glob(
+                os.path.join(args.baseline_dir, "BENCH_*.json"))):
+            if os.path.realpath(p) == fresh_real:
+                continue
+            try:
+                baselines.append(monitor.load_bench_json(p))
+            except ValueError as e:
+                # A failed round with no record line is part of history;
+                # it thins the trajectory, it doesn't break the gate.
+                print(f"# bench-diff: skipping {p}: {e}",
+                      file=sys.stderr)
+    for p in args.baseline or []:
+        baselines.append(monitor.load_bench_json(p))
+    if not baselines:
+        raise SystemExit("bench-diff needs at least one baseline "
+                         "(--baseline / --baseline-dir)")
+    fresh = monitor.load_bench_json(args.fresh)
+    metrics = args.metrics.split(",") if args.metrics else None
+    verdict = monitor.bench_diff(fresh, baselines, rel_tol=args.noise,
+                                 metrics=metrics,
+                                 cross_rig=args.cross_rig)
+    if args.json:
+        print(json.dumps(verdict))
+    else:
+        print(monitor.render_bench_diff(verdict))
+    return 0 if verdict["verdict"] == "pass" else 2
 
 
 def _cmd_trace_view(args: argparse.Namespace) -> int:
@@ -984,6 +1131,20 @@ def _looks_like_raw(path: str) -> bool:
 # `blit inventory` never pay the jax import just to build --product
 # choices; tests/test_cli.py pins the two lists equal).
 _PRODUCTS = ("0000", "0001", "0002")
+
+
+def _add_monitor_flags(parser: argparse.ArgumentParser) -> None:
+    """The shared ``--monitor-*`` flag set (ISSUE 11): commands that run
+    long enough to watch grow a live publisher switch."""
+    parser.add_argument("--monitor-spool", default=None,
+                        help="spool live telemetry samples (JSON lines) "
+                             "into this dir; `blit top --spool` tails it")
+    parser.add_argument("--monitor-port", type=int, default=None,
+                        help="serve /metrics, /healthz and /snapshot on "
+                             "this port while running (0 = ephemeral; "
+                             "the chosen port prints to stderr)")
+    parser.add_argument("--monitor-interval", type=float, default=0.25,
+                        help="publisher snapshot cadence in seconds")
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -1101,6 +1262,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     pl.add_argument("--done-file", default=None,
                     help="end-of-session marker path (default "
                          "<stem>.done)")
+    _add_monitor_flags(pl)
     pl.set_defaults(fn=_cmd_stream)
 
     ps = sub.add_parser(
@@ -1241,6 +1403,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "chunk past a tightened lateness budget must "
                          "yield a masked (not wedged) product and a "
                          "flight-recorder dump")
+    _add_monitor_flags(pg)
     pg.set_defaults(fn=_cmd_ingest_bench)
 
     pn = sub.add_parser(
@@ -1320,7 +1483,59 @@ def main(argv: Optional[List[str]] = None) -> int:
                     choices=["text", "prom", "json"],
                     help="report rendering: human text, Prometheus "
                          "exposition, or raw JSON")
+    pt.add_argument("--watch", type=float, default=None, metavar="N",
+                    help="re-harvest and re-render every N seconds "
+                         "(`blit top`'s refresh loop; Ctrl-C to stop)")
+    pt.add_argument("--iterations", type=int, default=None,
+                    help="with --watch: stop after this many frames "
+                         "(tests/scripts; default: until interrupted)")
     pt.set_defaults(fn=_cmd_telemetry)
+
+    po = sub.add_parser(
+        "top",
+        help="live terminal dashboard over a monitor spool dir or a "
+             "publisher endpoint (ISSUE 11)",
+    )
+    src = po.add_mutually_exclusive_group(required=True)
+    src.add_argument("--spool",
+                     help="monitor spool dir to tail (one JSONL file "
+                          "per process; merged into one fleet view)")
+    src.add_argument("--url",
+                     help="publisher base URL to poll "
+                          "(e.g. http://127.0.0.1:8080)")
+    po.add_argument("--interval", type=float, default=1.0,
+                    help="refresh cadence in seconds")
+    po.add_argument("--once", action="store_true",
+                    help="render one frame (no ANSI clear) and exit")
+    po.add_argument("--iterations", type=int, default=None,
+                    help="stop after this many frames (tests/scripts)")
+    po.set_defaults(fn=_cmd_top)
+
+    pd = sub.add_parser(
+        "bench-diff",
+        help="compare a fresh bench.py / ingest-bench JSON against the "
+             "checked-in BENCH_*.json trajectory (exit 2 on regress)",
+    )
+    pd.add_argument("fresh",
+                    help="fresh bench record (plain JSON or a "
+                         "BENCH_*.json wrapper)")
+    pd.add_argument("--baseline", action="append", default=[],
+                    help="baseline record (repeatable)")
+    pd.add_argument("--baseline-dir", default=None,
+                    help="load every BENCH_*.json here as the baseline "
+                         "trajectory (the fresh file itself excluded)")
+    pd.add_argument("--noise", type=float, default=0.35,
+                    help="relative noise band around the trajectory's "
+                         "[min, max] envelope (0.35 = ±35%%)")
+    pd.add_argument("--metrics", default=None,
+                    help="comma-separated metric filter (default: every "
+                         "shared metric)")
+    pd.add_argument("--cross-rig", action="store_true",
+                    help="compare against baselines from OTHER rigs "
+                         "(config.backend) too — default: same-rig only")
+    pd.add_argument("--json", action="store_true",
+                    help="print the verdict as JSON instead of a table")
+    pd.set_defaults(fn=_cmd_bench_diff)
 
     pv = sub.add_parser(
         "trace-view",

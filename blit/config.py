@@ -159,6 +159,38 @@ class SiteConfig:
     mesh_probe_windows: int = 2
     mesh_prefetch_depth: Optional[int] = None
     mesh_out_depth: Optional[int] = None
+    # Live monitoring & SLO plane (blit/monitor.py; ISSUE 11).  The
+    # publisher is OFF unless a spool dir or an HTTP port is configured
+    # (monitor_port=0 binds an ephemeral port; None = no endpoint) —
+    # monitoring must cost nothing when nobody is watching.
+    # monitor_interval_s is the snapshot cadence (delta-based: each
+    # sample carries only the interval's stage/histogram increments plus
+    # the cumulative state for fleet merges).  Per-process overrides:
+    # BLIT_MONITOR_INTERVAL / BLIT_MONITOR_PORT / BLIT_MONITOR_SPOOL
+    # (:func:`monitor_defaults`).
+    monitor_interval_s: float = 1.0
+    monitor_port: Optional[int] = None
+    monitor_spool_dir: Optional[str] = None
+    # Service-level objectives evaluated continuously over the live
+    # histogram deltas (multi-window burn rate, blit/monitor.py).  Each
+    # enabled objective pages when the error budget (slo_budget: the
+    # allowed bad-sample fraction) burns faster than slo_fast_burn over
+    # the last slo_fast_window samples AND faster than slo_slow_burn
+    # over the last slo_slow_window samples (the SRE multi-window rule:
+    # fast to catch an outage, slow to stop flapping).  None disables an
+    # objective.  Per-process overrides: BLIT_SLO_SERVE_WAIT_P99 /
+    # BLIT_SLO_STREAM_P99 / BLIT_SLO_INGEST_GBPS_FLOOR
+    # (:func:`slo_defaults`); slo_objectives appends raw extra objective
+    # dicts ({"name","kind","metric","threshold"[,"budget"]}).
+    slo_serve_wait_p99_s: Optional[float] = None
+    slo_stream_latency_p99_s: Optional[float] = None
+    slo_ingest_gbps_floor: Optional[float] = None
+    slo_budget: float = 0.01
+    slo_fast_burn: float = 14.0
+    slo_slow_burn: float = 2.0
+    slo_fast_window: int = 5
+    slo_slow_window: int = 30
+    slo_objectives: Optional[List[Dict]] = None
 
     def io_retry_policy(self):
         """The :class:`blit.faults.RetryPolicy` for worker-side file I/O —
@@ -284,6 +316,71 @@ def mesh_defaults(config: SiteConfig = DEFAULT) -> Dict:
         "out_depth": opt_int(
             "BLIT_MESH_OUT_DEPTH", config.mesh_out_depth),
     }
+
+
+def monitor_defaults(config: SiteConfig = DEFAULT) -> Dict:
+    """The effective monitoring knob set (ISSUE 11): ``config``'s values
+    with per-process ``BLIT_MONITOR_*`` environment overrides applied —
+    the :func:`stream_defaults` pattern, resolved when a publisher (or
+    the process-wide auto-publisher, :func:`blit.monitor.ensure_publisher`)
+    is constructed.  ``enabled`` is derived: monitoring is on only when a
+    spool dir or an HTTP port is configured."""
+    port_env = os.environ.get("BLIT_MONITOR_PORT")
+    port = (int(port_env) if port_env not in (None, "")
+            else config.monitor_port)
+    if port is not None and port < 0:
+        port = None  # the -1 "disabled" encoding of the other planes
+    spool = os.environ.get("BLIT_MONITOR_SPOOL")
+    if spool is None:
+        spool = config.monitor_spool_dir
+    elif not spool:
+        spool = None
+    return {
+        "interval_s": float(os.environ.get(
+            "BLIT_MONITOR_INTERVAL", config.monitor_interval_s)),
+        "port": port,
+        "spool_dir": spool,
+        "enabled": port is not None or spool is not None,
+    }
+
+
+def slo_defaults(config: SiteConfig = DEFAULT) -> List[Dict]:
+    """The effective SLO objective list (ISSUE 11): the three built-in
+    site objectives (serve queue-wait p99 ceiling, live chunk→product
+    p99 ceiling, ingest GB/s floor), each enabled by its SiteConfig
+    field or ``BLIT_SLO_*`` env override, plus any raw extras from
+    ``config.slo_objectives``.  Returned as plain dicts —
+    :class:`blit.monitor.SLObjective` adopts them — so declaring an
+    objective never imports the monitoring plane."""
+
+    def opt_f(env: str, fallback: Optional[float]) -> Optional[float]:
+        v = os.environ.get(env)
+        if v is None:
+            return fallback
+        if not v or v.lower() == "none":
+            return None
+        f = float(v)
+        return None if f < 0 else f
+
+    objs: List[Dict] = []
+    wait = opt_f("BLIT_SLO_SERVE_WAIT_P99", config.slo_serve_wait_p99_s)
+    if wait is not None:
+        objs.append({"name": "serve-queue-wait", "kind": "latency",
+                     "metric": "sched.wait_s", "threshold": wait,
+                     "budget": config.slo_budget})
+    lat = opt_f("BLIT_SLO_STREAM_P99", config.slo_stream_latency_p99_s)
+    if lat is not None:
+        objs.append({"name": "stream-latency", "kind": "latency",
+                     "metric": "stream.chunk_to_product_s",
+                     "threshold": lat, "budget": config.slo_budget})
+    floor = opt_f("BLIT_SLO_INGEST_GBPS_FLOOR",
+                  config.slo_ingest_gbps_floor)
+    if floor is not None:
+        objs.append({"name": "ingest-throughput", "kind": "throughput",
+                     "metric": "ingest", "threshold": floor,
+                     "budget": config.slo_budget})
+    objs.extend(config.slo_objectives or [])
+    return objs
 
 
 def default_window_frames(nfft: int) -> int:

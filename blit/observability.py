@@ -106,6 +106,16 @@ _HIST_NBUCKETS = 64
 _LOG2 = math.log(2.0)
 
 
+def hist_bucket_edges() -> List[float]:
+    """The UPPER edge of every histogram bucket, in order: bucket 0
+    holds values <= 1 µs, bucket i (i >= 1) covers
+    ``(base·2^(i-1), base·2^i]`` — so edge ``i`` is ``base·2^i``.  The
+    Prometheus ``le`` labels of the native exposition
+    (:func:`render_prometheus`) and the SLO bad-sample cut
+    (:func:`blit.monitor.bad_fraction`) both derive from this one list."""
+    return [_HIST_BASE * 2.0 ** i for i in range(_HIST_NBUCKETS)]
+
+
 class HistogramStats:
     """Log-bucketed value distribution: bounded memory (64 counters),
     mergeable across processes, quantiles good to one bucket (a factor of
@@ -968,6 +978,12 @@ def merge_fleet(snapshots: Iterable[Optional[Dict]],
     report = {
         "hosts": {
             h: {"workers": e["workers"], "stages": e["tl"].report(),
+                # Raw (unrounded) bucket counts per histogram: what the
+                # native Prometheus histogram series render from
+                # (ISSUE 11 satellite) — the quantile block in "stages"
+                # is a rounded projection, not mergeable or bucketable.
+                "hist_state": {k: hh.state()
+                               for k, hh in list(e["tl"].hists.items())},
                 "faults": e["faults"]}
             for h, e in sorted(hosts.items())
         },
@@ -1003,10 +1019,27 @@ def maybe_write_report(path: Optional[str] = None) -> Optional[str]:
         return None
 
 
+def prom_escape(value) -> str:
+    """Prometheus label-VALUE escaping (exposition format: backslash,
+    double quote and newline are the three escapes)."""
+    return (str(value).replace("\\", "\\\\").replace("\n", "\\n")
+            .replace('"', '\\"'))
+
+
 def render_prometheus(report: Dict) -> str:
     """A fleet report (:func:`merge_fleet`) in Prometheus exposition
     format — one scrape body with host-labelled stage/gauge/histogram/
-    fault series (the ``python -m blit telemetry --format prom`` output)."""
+    fault series (the ``python -m blit telemetry --format prom`` output
+    and the monitor endpoint's ``/metrics`` body, blit/monitor.py).
+
+    Histograms are NATIVE Prometheus histogram series (ISSUE 11
+    satellite): cumulative ``_bucket`` counts at the log2 bucket edges
+    (:func:`hist_bucket_edges`) plus exact ``_sum``/``_count``, rendered
+    from the per-host raw ``hist_state`` a :func:`merge_fleet` report
+    carries — so a real Prometheus server computes any quantile over any
+    window, instead of scraping our precomputed p50/p90/p99 (which still
+    ride along as ``blit_latency_quantile`` gauges, and are all a saved
+    legacy report without raw state can offer)."""
     lines: List[str] = []
 
     def head(metric: str, mtype: str, help_: str) -> None:
@@ -1018,32 +1051,57 @@ def render_prometheus(report: Dict) -> str:
     head("blit_stage_calls_total", "counter", "Stage invocations")
     head("blit_stage_bytes_total", "counter", "Bytes moved per stage")
     head("blit_gauge", "gauge", "Last sampled level")
-    head("blit_latency_seconds", "summary",
-         "Log-bucketed latency distribution quantiles")
+    head("blit_latency_seconds", "histogram",
+         "Log-bucketed latency distribution (64 log2 buckets from 1 us)")
+    head("blit_latency_quantile", "gauge",
+         "Precomputed latency quantiles (seconds; bucket-midpoint "
+         "estimates)")
     head("blit_fault_total", "counter", "Failure/recovery counters")
+    edges = hist_bucket_edges()
     for host, e in (report.get("hosts") or {}).items():
+        hl = prom_escape(host)
         stages = e.get("stages") or {}
         for k, row in stages.items():
             if k in ("gauges", "hists", "faults") or not isinstance(row, dict):
                 continue
-            lab = f'{{host="{host}",stage="{k}"}}'
+            lab = f'{{host="{hl}",stage="{prom_escape(k)}"}}'
             lines.append(f"blit_stage_seconds_total{lab} {row.get('seconds', 0)}")
             lines.append(f"blit_stage_calls_total{lab} {row.get('calls', 0)}")
             lines.append(f"blit_stage_bytes_total{lab} {row.get('bytes', 0)}")
         for k, g in (stages.get("gauges") or {}).items():
             lines.append(
-                f'blit_gauge{{host="{host}",name="{k}"}} {g.get("last", 0)}')
+                f'blit_gauge{{host="{hl}",name="{prom_escape(k)}"}} '
+                f'{g.get("last", 0)}')
+        hist_state = e.get("hist_state") or {}
         for k, h in (stages.get("hists") or {}).items():
+            nl = prom_escape(k)
+            st = hist_state.get(k)
+            if st:
+                acc = 0
+                for i, c in enumerate(st.get("counts") or []):
+                    if not c:
+                        continue
+                    acc += int(c)
+                    lines.append(
+                        f'blit_latency_seconds_bucket{{host="{hl}",'
+                        f'name="{nl}",le="{edges[i]:.10g}"}} {acc}')
+                lines.append(
+                    f'blit_latency_seconds_bucket{{host="{hl}",'
+                    f'name="{nl}",le="+Inf"}} {int(st.get("n", 0))}')
+                lines.append(
+                    f'blit_latency_seconds_sum{{host="{hl}",name="{nl}"}} '
+                    f'{st.get("total", 0.0)}')
+                lines.append(
+                    f'blit_latency_seconds_count{{host="{hl}",'
+                    f'name="{nl}"}} {int(st.get("n", 0))}')
             for q, key in (("0.5", "p50"), ("0.9", "p90"), ("0.99", "p99")):
                 lines.append(
-                    f'blit_latency_seconds{{host="{host}",name="{k}",'
+                    f'blit_latency_quantile{{host="{hl}",name="{nl}",'
                     f'quantile="{q}"}} {h.get(key, 0)}')
-            lines.append(
-                f'blit_latency_seconds_count{{host="{host}",name="{k}"}} '
-                f'{h.get("n", 0)}')
         for k, v in (e.get("faults") or {}).items():
             lines.append(
-                f'blit_fault_total{{host="{host}",counter="{k}"}} {v}')
+                f'blit_fault_total{{host="{hl}",'
+                f'counter="{prom_escape(k)}"}} {v}')
     return "\n".join(lines) + "\n"
 
 

@@ -19,6 +19,7 @@ from typing import Dict, Optional, Sequence, Tuple
 import numpy as np
 
 from blit.io.guppi import GuppiRaw, open_raw
+from blit.monitor import published
 from blit.ops.channelize import (
     STOKES_NIF,
     output_header,
@@ -639,6 +640,7 @@ def load_scan_mesh(
     return hdr, out
 
 
+@published
 def reduce_scan_mesh_to_files(
     raw_paths,
     scan: Optional[str] = None,
@@ -841,6 +843,7 @@ def reduce_scan_mesh_to_files(
     return {band_ids[b]: (out_paths[b], headers[b]) for b in mine}
 
 
+@published
 def reduce_scan_pool_to_files(
     raw_paths,
     scan: Optional[str] = None,

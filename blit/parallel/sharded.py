@@ -50,6 +50,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from blit import observability
+from blit.monitor import published
 from blit.observability import Timeline, profile_trace
 from blit.ops.channelize import pfb_coeffs, usable_frames
 from blit.parallel import mesh as M
@@ -207,6 +208,7 @@ def _mesh_probe_windows() -> int:
     return mesh_defaults()["probe_windows"]
 
 
+@published
 def reduce_scan_sharded_to_files(
     raw_paths,
     scan: Optional[str] = None,
@@ -462,6 +464,7 @@ def _mesh_dedoppler():
     return _MESH_DEDOPPLER
 
 
+@published
 def search_scan_sharded_to_files(
     raw_paths,
     scan: Optional[str] = None,

@@ -640,7 +640,19 @@ class RawReducer:
         crash contract: ``.partial`` dropped, resumable file + cursor
         kept) and the error re-raised.  The synchronous fallback
         (``async_output=False``) keeps the seed's serialized shape for
-        A/B drills."""
+        A/B drills.
+
+        Runs under :func:`blit.monitor.publishing` — every reduction
+        (batch, stream, serve, search) streams its live timeline to the
+        process publisher when ``BLIT_MONITOR_*`` enables one (ISSUE 11);
+        disabled, the scope costs two env reads per reduction."""
+        from blit.monitor import publishing
+
+        with publishing(self.timeline):
+            return self._pump_impl(raw, writer, skip_frames)
+
+    def _pump_impl(self, raw: GuppiRaw, writer, skip_frames: int = 0
+                   ) -> int:
         if not self.async_output:
             try:
                 # stream() opens the profiler trace itself on this path,

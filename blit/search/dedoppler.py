@@ -384,7 +384,15 @@ class DedopplerReducer:
         through :class:`~blit.outplane.AsyncSink` on the async plane —
         and finalize it.  Returns hits written this run.  On error the
         writer ``abort()``s (its own crash contract) and the error
-        re-raises."""
+        re-raises.  Runs under :func:`blit.monitor.publishing` like
+        :meth:`blit.pipeline.RawReducer._pump` (ISSUE 11)."""
+        from blit.monitor import publishing
+
+        with publishing(self.timeline):
+            return self._pump_impl(raw, hdr, writer, skip_windows)
+
+    def _pump_impl(self, raw: GuppiRaw, hdr: Dict, writer,
+                   skip_windows: int = 0) -> int:
         if not self.async_output:
             try:
                 for widx, hits in self._search_stream(raw, hdr,

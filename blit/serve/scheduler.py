@@ -117,9 +117,18 @@ class Scheduler:
         pool=None,
         timeline: Optional[Timeline] = None,
         clock: Callable[[], float] = time.monotonic,
+        wait_est_floor: int = 32,
     ):
         self.max_concurrency = max(1, int(max_concurrency))
         self.queue_depth = max(1, int(queue_depth))
+        # Admission estimator regime switch (ISSUE 11 satellite; the
+        # ROADMAP item-3 carve-out): below this many recorded waits the
+        # EWMA model estimates, at/above it the REAL wait_hist p99 does.
+        self.wait_est_floor = max(1, int(wait_est_floor))
+        # Load-shed level in [0, 0.9] (SLO breach hook, blit/monitor.py):
+        # scales the concurrency budget and the admitted queue depth
+        # down while an objective burns.
+        self._shed = 0.0
         self.pool = pool
         self.timeline = timeline if timeline is not None else Timeline()
         self.clock = clock
@@ -146,12 +155,40 @@ class Scheduler:
         }
 
     # -- capacity ----------------------------------------------------------
+    def shed(self, fraction: float) -> None:
+        """Tighten (or relax) admission by a load-shed fraction in
+        ``[0, 0.9]`` — the SLO breach action
+        (:meth:`blit.monitor.BurnRateEvaluator.attach_scheduler`): the
+        concurrency budget and the admitted queue depth both scale by
+        ``1 - fraction`` while shed, so an overloaded service refuses
+        work at the door instead of queueing latency it already cannot
+        serve.  ``shed(0.0)`` restores full admission."""
+        f = min(0.9, max(0.0, float(fraction)))
+        changed = f != self._shed
+        self._shed = f
+        self.timeline.gauge("sched.shed", f)
+        if changed:
+            self.timeline.count("sched.shed_change")
+            if f:
+                log.warning("load shed engaged: admission scaled to "
+                            "%.0f%%", (1.0 - f) * 100)
+            else:
+                log.info("load shed released: full admission restored")
+
+    def shed_level(self) -> float:
+        return self._shed
+
+    def _shed_queue_depth(self) -> int:
+        """The per-priority queue bound under the current shed level."""
+        return max(1, int(self.queue_depth * (1.0 - self._shed)))
+
     def effective_budget(self) -> int:
         """The concurrency budget RIGHT NOW: the base budget scaled down
-        by the fraction of degraded (breaker-open) hosts when a pool is
+        by the current load-shed level (SLO breach hook) and by the
+        fraction of degraded (breaker-open) hosts when a pool is
         attached; never below 1 (a fully degraded cluster still probes
         forward instead of wedging the queue)."""
-        base = self.max_concurrency
+        base = max(1, int(self.max_concurrency * (1.0 - self._shed)))
         if self.pool is None:
             return base
         health = self.pool.health()
@@ -171,15 +208,29 @@ class Scheduler:
             return self._running
 
     def est_wait_s(self, priority: int) -> float:
-        """Expected queue wait for a NEW job at ``priority``: the work
-        ahead of it (running + queued at priorities <= it), in units of
-        the observed mean service time, divided by the current budget.
-        Zero until the first job completes (no unit cost observed)."""
+        """Expected queue wait for a NEW job at ``priority``.
+
+        Two regimes (ISSUE 11 satellite — the ROADMAP item-3 carve-out):
+        once ``wait_hist`` holds at least ``wait_est_floor`` recorded
+        waits, the estimate is the REAL observed p99 queue wait (the
+        tail the caller would actually risk — telemetry-hist-driven
+        admission); below the floor it falls back to the EWMA model
+        (work ahead x mean service time / budget), which is all a cold
+        scheduler has.  Zero either way when nothing is ahead — an
+        empty scheduler's history predicts nothing about an empty
+        queue."""
         with self._lock:
             ahead = self._running + sum(
                 n for p, n in self._queued.items() if p <= priority
             )
             svc = self._svc_ewma
+            n = self.wait_hist.n
+            p99 = (self.wait_hist.percentile(0.99)
+                   if n >= self.wait_est_floor else None)
+        if ahead == 0:
+            return 0.0
+        if p99 is not None:
+            return p99
         budget = self.effective_budget()
         return (ahead * svc) / max(1, budget)
 
@@ -202,12 +253,15 @@ class Scheduler:
         now = self.clock()
         est = self.est_wait_s(priority)
         with self._lock:
-            if self._queued.get(priority, 0) >= self.queue_depth:
+            depth_cap = self._shed_queue_depth()
+            if self._queued.get(priority, 0) >= depth_cap:
                 self.counts["rejected"] += 1
                 self.timeline.count("sched.rejected")
+                shed = (f", shedding {self._shed * 100:.0f}%"
+                        if self._shed else "")
                 raise Overloaded(
                     f"priority-{priority} queue full "
-                    f"({self.queue_depth} jobs); try later",
+                    f"({depth_cap} jobs{shed}); try later",
                     retry_after_s=max(0.1, est),
                 )
             if deadline_s is not None and est > deadline_s:
@@ -271,6 +325,7 @@ class Scheduler:
             self.wait_hist.observe(wait)
             self.timeline.gauge("sched.wait_s", wait)
             self.timeline.observe("sched.wait_s", wait)
+            self.timeline.gauge("sched.running", self._running)
             threading.Thread(
                 target=self._run, args=(job,),
                 name=f"blit-serve-{job.client}", daemon=True,
@@ -298,6 +353,7 @@ class Scheduler:
                     else 0.7 * self._svc_ewma + 0.3 * dt
                 )
                 self._running -= 1
+                self.timeline.gauge("sched.running", self._running)
                 job.state = "done"
                 job.finished_at = self.clock()
                 self._dispatch_locked()

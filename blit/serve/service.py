@@ -203,6 +203,18 @@ class ProductService:
             "requests": 0, "coalesced": 0, "cache_hits": 0,
             "scheduled": 0, "rejected": 0,
         }
+        # Live monitoring (ISSUE 11): when the process-wide publisher is
+        # enabled (BLIT_MONITOR_* / SiteConfig monitor_* knobs), this
+        # service's timeline joins its watch set — queue depth, wait
+        # tails and cache counters stream to the spool/endpoint while
+        # requests flow — and SLO breaches shed THIS scheduler's
+        # admission (Scheduler.shed) until the burn clears.
+        from blit import monitor
+
+        self._publisher = monitor.ensure_publisher(config)
+        if self._publisher is not None:
+            self._publisher.watch(self.timeline)
+            self._publisher.slo.attach_scheduler(self.scheduler)
 
     # -- submission --------------------------------------------------------
     def submit(
@@ -395,9 +407,14 @@ class ProductService:
         out["hit_rate"] = round(served / total, 4) if total else 0.0
         out["queue_wait"] = self.scheduler.wait_percentiles()
         out["budget"] = self.scheduler.effective_budget()
+        out["shed"] = self.scheduler.shed_level()
         return out
 
     def close(self, timeout: Optional[float] = 30.0) -> None:
+        if self._publisher is not None:
+            self._publisher.unwatch(self.timeline)
+            self._publisher.slo.detach_scheduler(self.scheduler)
+            self._publisher = None
         self.scheduler.close(timeout)
 
     def __enter__(self):

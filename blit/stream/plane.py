@@ -431,8 +431,14 @@ def stream_reduce(source: ChunkSource, out_path: str, *,
         source, lateness_s=lateness_s, stall_timeout_s=stall_timeout_s,
         timeline=red.timeline, config=config,
     )
-    with observability.span("stream.reduce", out=out_path,
-                            nfft=red.nfft, path=live.path):
+    # The WHOLE session publishes (ISSUE 11), not just the pump: a live
+    # feed can spend minutes waiting for its first chunk, and `blit top`
+    # must show the watermark/queue gauges during that wait too.
+    from blit.monitor import publishing
+
+    with publishing(red.timeline, config=config), \
+            observability.span("stream.reduce", out=out_path,
+                               nfft=red.nfft, path=live.path):
         hdr = red.header_for(live)
         nif = STOKES_NIF[red.stokes]
         if out_path.endswith((".h5", ".hdf5")):
@@ -495,8 +501,11 @@ def stream_search(source: ChunkSource, out_path: str, *,
         source, lateness_s=lateness_s, stall_timeout_s=stall_timeout_s,
         timeline=red.timeline, config=config,
     )
-    with observability.span("stream.search", out=out_path,
-                            nfft=red.nfft, path=live.path):
+    from blit.monitor import publishing
+
+    with publishing(red.timeline, config=config), \
+            observability.span("stream.search", out=out_path,
+                               nfft=red.nfft, path=live.path):
         hdr = red.header_for(live)
         w = HitsWriter(out_path, hdr)
         tap = _LatencyTap(w, live, red.timeline, nfft=red.nfft,

@@ -188,6 +188,120 @@ class TestAdmissionControl:
         pool.shutdown()
 
 
+class TestWaitEstimatorRegimes:
+    """ISSUE 11 satellite (the ROADMAP item-3 carve-out): admission uses
+    the REAL wait_hist p99 once enough samples exist, with the EWMA
+    model below the sample floor."""
+
+    def test_below_floor_uses_the_ewma_model(self):
+        g = Gate()
+        s = Scheduler(max_concurrency=1, queue_depth=16,
+                      wait_est_floor=1000)
+        s.submit(lambda: time.sleep(0.05)).result(timeout=10)
+        s.submit(g.job("blocker"))
+        wait_for(g.started.is_set)
+        for i in range(4):
+            s.submit(g.instant(i))
+        # EWMA regime: the estimate is backlog x service time — it
+        # scales with the queue depth, unlike a static p99.
+        est4 = s.est_wait_s(1)
+        assert est4 == pytest.approx(5 * s._svc_ewma, rel=1e-6)
+        s.submit(g.instant("more"))
+        assert s.est_wait_s(1) > est4
+        g.release.set()
+        s.close()
+
+    def test_at_floor_the_real_p99_estimates(self):
+        # Seed the wait histogram with KNOWN waits via the injectable
+        # clock: 100 recorded queue waits around 2s (p99 ~ 2s), then a
+        # trivial EWMA — the regimes disagree wildly, and the estimate
+        # must follow the histogram.
+        s = Scheduler(max_concurrency=1, wait_est_floor=32)
+        for _ in range(100):
+            s.wait_hist.observe(2.0)
+        s._svc_ewma = 0.001
+        s._svc_n = 1
+        with s._lock:
+            s._queued[1] = 3  # synthetic backlog (ahead > 0)
+        est = s.est_wait_s(1)
+        p99 = s.wait_hist.percentile(0.99)
+        assert est == pytest.approx(p99)
+        assert est > 1.0  # nowhere near the EWMA model's ~0.003
+        # An EMPTY scheduler predicts no wait whatever the history says.
+        with s._lock:
+            s._queued[1] = 0
+        assert s.est_wait_s(1) == 0.0
+        # Deadline admission now rejects on the observed tail.
+        with s._lock:
+            s._queued[1] = 3
+        with pytest.raises(Overloaded):
+            s.submit(lambda: None, deadline_s=0.5)
+        with s._lock:
+            s._queued[1] = 0
+        s.close(timeout=1)
+
+    def test_floor_boundary(self):
+        s = Scheduler(max_concurrency=1, wait_est_floor=4)
+        for _ in range(3):
+            s.wait_hist.observe(5.0)
+        s._svc_ewma = 0.01
+        s._svc_n = 1
+        with s._lock:
+            s._queued[1] = 2
+        below = s.est_wait_s(1)  # n=3 < floor: EWMA model
+        assert below < 1.0
+        s.wait_hist.observe(5.0)  # n=4 == floor: histogram p99
+        assert s.est_wait_s(1) > 1.0
+        with s._lock:
+            s._queued[1] = 0
+        s.close(timeout=1)
+
+
+class TestLoadShed:
+    """Scheduler.shed — the SLO breach action (ISSUE 11)."""
+
+    def test_shed_scales_budget_and_queue_depth(self):
+        s = Scheduler(max_concurrency=4, queue_depth=8)
+        assert s.effective_budget() == 4
+        s.shed(0.5)
+        assert s.shed_level() == 0.5
+        assert s.effective_budget() == 2
+        assert s._shed_queue_depth() == 4
+        s.shed(0.0)
+        assert s.effective_budget() == 4
+        # Clamped: a hook can never shed to zero admission.
+        s.shed(5.0)
+        assert s.shed_level() == 0.9
+        assert s.effective_budget() >= 1
+        assert s._shed_queue_depth() >= 1
+        s.close()
+
+    def test_shed_queue_bound_rejects_at_the_tightened_door(self):
+        g = Gate()
+        s = Scheduler(max_concurrency=1, queue_depth=4)
+        s.submit(g.job("blocker"))
+        wait_for(g.started.is_set)
+        s.shed(0.5)  # admitted depth: 2
+        s.submit(g.instant("a"))
+        s.submit(g.instant("b"))
+        with pytest.raises(Overloaded, match="shedding"):
+            s.submit(g.instant("c"))
+        s.shed(0.0)
+        s.submit(g.instant("c"))  # released: full depth again
+        g.release.set()
+        s.close()
+
+    def test_shed_is_gauged(self):
+        tl = Timeline()
+        s = Scheduler(max_concurrency=2, timeline=tl)
+        s.shed(0.5)
+        assert tl.gauges["sched.shed"].last == 0.5
+        assert tl.stages["sched.shed_change"].calls == 1
+        s.shed(0.5)  # unchanged: no extra change event
+        assert tl.stages["sched.shed_change"].calls == 1
+        s.close()
+
+
 class TestCancellation:
     def test_cancel_queued_job_releases_its_slot(self):
         g = Gate()
