@@ -47,6 +47,11 @@ Commands:
   trace-view Render a flight-recorder dump (written automatically when a
              stall watchdog trips, a breaker opens, or an agent dies)
              into a readable incident summary.
+  chaos      Crash-recovery drill (ISSUE 12): run a seeded kill/hang
+             schedule against a real supervised sharded scan or live
+             stream and assert detection, degrade-and-resume (reshaped
+             mesh or pool fallback / session rejoin) and product
+             byte-identity against an uninterrupted oracle.
   top        Live terminal dashboard (ISSUE 11): tail a monitor spool
              dir or poll a publisher endpoint during an in-progress
              reduce/scan/stream/serve — per-stage throughput, stage-tail
@@ -165,7 +170,7 @@ def _cmd_stream(args: argparse.Namespace) -> int:
             src, args.output, lateness_s=args.lateness, nfft=nfft,
             nint=nint, dtype=args.dtype, timeline=tl,
             window_spectra=args.window_spectra, snr_threshold=args.snr,
-            top_k=args.top_k,
+            top_k=args.top_k, resume=args.resume,
         )
         body = {"hits": hdr.get("search_nhits"),
                 "windows": hdr.get("search_windows")}
@@ -176,6 +181,7 @@ def _cmd_stream(args: argparse.Namespace) -> int:
             src, args.output, lateness_s=args.lateness, nfft=nfft,
             nint=nint, stokes=args.stokes, fqav_by=args.fqav,
             dtype=args.dtype, compression=args.compression, timeline=tl,
+            resume=args.resume,
         )
         body = {"nsamps": hdr.get("nsamps"), "nchans": hdr.get("nchans")}
     lat = tl.report().get("hists", {}).get("stream.chunk_to_product_s", {})
@@ -732,6 +738,66 @@ def _cmd_ingest_bench(args: argparse.Namespace) -> int:
             leg["flight_dump"] = hdr["stream_flight_dump"]
         return leg
 
+    def run_chaos() -> dict:
+        """The recovery leg (ISSUE 12): a live consumer is SIGKILLed
+        mid-session by a seeded ``stream.chunk:kill`` fault, the
+        :class:`blit.recover.StreamSupervisor` detects the death and
+        restarts it with ``resume=True`` (StreamCursor rejoin), and the
+        leg reports detection latency (``recover.detect_s``), recovery
+        time (``recover.resume_s``), the frames the rejoin recomputed,
+        and product byte-identity against the batch oracle."""
+        from blit.observability import Timeline
+        from blit.recover import StreamSupervisor
+        from blit.stream import StreamCursor
+
+        nblocks = max(4, args.blocks)
+        ntime = (args.chunks * args.chunk_frames + 3) * args.nfft
+        chaos_raw = os.path.join(td, "chaos.raw")
+        synth_raw(chaos_raw, nblocks=nblocks, obsnchan=args.nchan,
+                  ntime_per_block=-(-ntime // nblocks))
+        oracle = os.path.join(td, "chaos_oracle.fil")
+        RawReducer(nfft=args.nfft, nint=args.nint,
+                   chunk_frames=args.chunk_frames, fqav_by=args.fqav,
+                   dtype=args.dtype,
+                   tune_online=False).reduce_to_file(chaos_raw, oracle)
+        out = os.path.join(td, "chaos.fil")
+        tl = Timeline()
+        sup = StreamSupervisor(
+            chaos_raw, out, kind="reduce",
+            knobs=dict(nfft=args.nfft, nint=args.nint,
+                       chunk_frames=args.chunk_frames,
+                       fqav_by=args.fqav, dtype=args.dtype,
+                       tune_online=False),
+            replay_rate=args.chaos_rate,
+            faults=f"stream.chunk:kill:after={args.chaos_after}",
+            lease_ttl_s=3.0, poll_s=0.05, timeline=tl,
+        )
+        import filecmp
+
+        t0 = _time.perf_counter()
+        rep = _chaos_run(sup)  # a failed drill becomes a failed LEG
+        wall = _time.perf_counter() - t0
+        try:
+            identical = filecmp.cmp(out, oracle, shallow=False)
+        except OSError:
+            identical = False
+        hists = tl.report().get("hists", {})
+        cur = StreamCursor.load(out)  # removed on clean completion
+        frames_claimed_at_crash = None
+        for a in rep.get("attempts", []):
+            if not a.get("ok", True):
+                frames_claimed_at_crash = a.get("failure", {})
+        return {
+            "wall_s": round(wall, 3),
+            "recovered": rep.get("recovered"),
+            "attempts": len(rep.get("attempts", [])),
+            "products_identical": identical,
+            "cursor_removed": cur is None,
+            "detect": hists.get("recover.detect_s", {}),
+            "resume": hists.get("recover.resume_s", {}),
+            "failure": frames_claimed_at_crash,
+        }
+
     # --chunk-frames 0 (or negative) = auto: resolve from this rig's
     # tuning profile (blit/tune.py) exactly as `blit reduce` would; the
     # probe's provenance is embedded in the report's ingest_config.
@@ -794,6 +860,8 @@ def _cmd_ingest_bench(args: argparse.Namespace) -> int:
             report["live"] = run_live(False)
         if args.live_drill:
             report["live_drill"] = run_live(True)
+        if args.chaos:
+            report["chaos"] = run_chaos()
         if len(legs) == 2 and legs[1]["wall_s"] > 0:
             from blit.testing import sync_compare_verdict
 
@@ -941,6 +1009,173 @@ def _cmd_tune(args: argparse.Namespace) -> int:
             "trials": trials,
         }))
     return 0
+
+
+def _chaos_run(sup) -> dict:
+    """Run a supervisor for the chaos drill, converting an exhausted
+    recovery budget into a failed REPORT instead of a traceback — the
+    --json-out artifact must exist exactly when the drill fails (that
+    is the run CI needs to triage)."""
+    try:
+        rep = sup.run()
+    except RuntimeError as e:
+        rep = {"recovered": False, "error": str(e), "attempts": [],
+               "attempts_tried": sup.state().get("attempt", 0) + 1}
+    return rep
+
+
+def _cmd_chaos(args: argparse.Namespace) -> int:
+    """``blit chaos`` (ISSUE 12): run a SEEDED kill/hang schedule
+    against a real supervised workload — a multi-process sharded scan
+    (``--workload scan`` / ``scan-search``) or a live stream consumer
+    (``--workload stream``) — and assert the recovery contract end to
+    end: the failure is DETECTED within the lease budget, the scan
+    re-plans (reshaped mesh or pool fallback) / the consumer rejoins,
+    and the final products are BYTE-IDENTICAL to an uninterrupted
+    oracle run.  Prints (and optionally writes) the drill report JSON;
+    exit 0 = recovered and identical."""
+    import os
+    import tempfile
+
+    from blit.observability import Timeline
+    from blit.recover import RECOVER_HISTS, ScanSupervisor, StreamSupervisor
+    from blit.testing import synth_raw
+
+    tl = Timeline()
+    work = args.work_dir or tempfile.mkdtemp(prefix="blit-chaos-")
+    os.makedirs(work, exist_ok=True)
+    point = args.point or ("stream.chunk" if args.workload == "stream"
+                           else "mesh.window")
+    fault = (f"{point}:{args.fault}:after={args.after}"
+             + (f":hang={args.hang_s}" if args.fault == "hang" else ""))
+    report = {"workload": args.workload, "fault": fault,
+              "procs": args.procs}
+
+    if args.workload == "stream":
+        raw = os.path.join(work, "chaos.raw")
+        nblocks = max(4, args.chunks)
+        ntime = (args.chunks * args.window_frames + 3) * args.nfft
+        synth_raw(raw, nblocks=nblocks, obsnchan=args.nchan,
+                  ntime_per_block=-(-ntime // nblocks), seed=args.seed)
+        out = os.path.join(work, "chaos.fil")
+        oracle = os.path.join(work, "oracle.fil")
+        from blit.pipeline import RawReducer
+
+        RawReducer(nfft=args.nfft, nint=args.nint,
+                   chunk_frames=args.window_frames,
+                   tune_online=False).reduce_to_file(raw, oracle)
+        sup = StreamSupervisor(
+            raw, out, kind="reduce",
+            knobs=dict(nfft=args.nfft, nint=args.nint,
+                       chunk_frames=args.window_frames,
+                       tune_online=False),
+            replay_rate=args.replay_rate, faults=fault,
+            lease_ttl_s=args.lease_ttl, poll_s=args.poll,
+            max_attempts=args.attempts, timeline=tl,
+        )
+        rep = _chaos_run(sup)
+        products = [(out, oracle)]
+    else:
+        kind = "search" if args.workload == "scan-search" else "reduce"
+        grid = []
+        bank_bw = -187.5 / args.nbank
+        for b in range(args.nband):
+            row = []
+            for k in range(args.nbank):
+                p = os.path.join(work, f"blc{b}{k}.raw")
+                synth_raw(
+                    p, nblocks=2, obsnchan=args.nchan,
+                    ntime_per_block=-(-(args.chunks * args.window_frames
+                                        + 3) * args.nfft // 2),
+                    seed=args.seed + b * 8 + k, tone_chan=k % args.nchan,
+                    obsbw=bank_bw,
+                    obsfreq=8000.0 + b * 500.0 + (k + 0.5) * bank_bw,
+                )
+                row.append(p)
+            grid.append(row)
+        out_dir = os.path.join(work, "products")
+        oracle_dir = os.path.join(work, "oracle")
+        os.makedirs(oracle_dir, exist_ok=True)
+        search_kw = dict(window_spectra=args.window_spectra, top_k=4,
+                         snr_threshold=2.0, max_drift_bins=None,
+                         kernel="reference")
+        sup = ScanSupervisor(
+            grid, out_dir=out_dir, kind=kind, nfft=args.nfft,
+            nint=args.nint, despike=False,
+            window_frames=args.window_frames,
+            search=(search_kw if kind == "search" else None),
+            nprocs=args.procs,
+            devices_per_proc=(
+                args.devices_per_proc if args.devices_per_proc
+                else (args.nband * args.nbank) // args.procs),
+            lease_ttl_s=args.lease_ttl, poll_s=args.poll,
+            max_attempts=args.attempts,
+            faults={args.victim: fault}, timeline=tl,
+        )
+        rep = _chaos_run(sup)
+        # The pool oracle over the identical scan, at the SAME window
+        # granularity (dispatch shape is part of the identity contract).
+        wf = sup.wf
+        if kind == "search":
+            from blit.search import DedopplerReducer
+
+            products = []
+            for b in range(args.nband):
+                for k in range(args.nbank):
+                    op = os.path.join(oracle_dir, f"band{b}bank{k}.hits")
+                    DedopplerReducer(
+                        nfft=args.nfft, nint=args.nint, chunk_frames=wf,
+                        **search_kw,
+                    ).search_to_file(grid[b][k], op)
+                    products.append(
+                        (os.path.join(out_dir, f"band{b}bank{k}.hits"),
+                         op))
+        else:
+            from blit.parallel.scan import reduce_scan_pool_to_files
+
+            written = reduce_scan_pool_to_files(
+                grid, out_dir=oracle_dir, nfft=args.nfft,
+                nint=args.nint, despike=False, window_frames=wf)
+            products = [
+                (os.path.join(out_dir, os.path.basename(path)), path)
+                for _, (path, _) in sorted(written.items())
+            ]
+
+    import filecmp
+
+    identical = True
+    diffs = []
+    for got, want in products:
+        try:
+            # filecmp, not read()==read(): constant memory over
+            # realistically-sized products (the PR 8 compare rule).
+            same = filecmp.cmp(got, want, shallow=False)
+        except OSError:
+            same = False
+        if not same:
+            identical = False
+            diffs.append(got)
+    hists = tl.report().get("hists", {})
+    report.update(
+        recovered=rep.get("recovered", False),
+        error=rep.get("error"),
+        byte_identical=identical,
+        differing_products=diffs,
+        attempts=rep.get("attempts"),
+        result=rep.get("result"),
+        recover={h: hists.get(h, {}) for h in RECOVER_HISTS},
+        windows_recomputed=sum(
+            a.get("windows_recomputed", 0)
+            for a in (rep.get("attempts") or [])),
+        work_dir=work,
+    )
+    body = json.dumps(report)
+    print(body)
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            f.write(body)
+    ok = report["recovered"] and identical
+    return 0 if ok else 1
 
 
 def _cmd_telemetry(args: argparse.Namespace) -> int:
@@ -1262,6 +1497,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     pl.add_argument("--done-file", default=None,
                     help="end-of-session marker path (default "
                          "<stem>.done)")
+    pl.add_argument("--resume", action="store_true",
+                    help="rejoinable consumer (ISSUE 12): persist a "
+                         ".stream-cursor sidecar so a restarted "
+                         "consumer re-attaches to the still-recording "
+                         "session mid-file, byte-identical to a "
+                         "never-restarted one")
     _add_monitor_flags(pl)
     pl.set_defaults(fn=_cmd_stream)
 
@@ -1403,6 +1644,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "chunk past a tightened lateness budget must "
                          "yield a masked (not wedged) product and a "
                          "flight-recorder dump")
+    pg.add_argument("--chaos", action="store_true",
+                    help="also run the recovery drill (ISSUE 12): "
+                         "SIGKILL a supervised live consumer "
+                         "mid-session, rejoin via the StreamCursor, "
+                         "and report recover.detect_s / "
+                         "recover.resume_s + byte-identity")
+    pg.add_argument("--chaos-after", type=int, default=2,
+                    help="kill the consumer after this many chunks")
+    pg.add_argument("--chaos-rate", type=float, default=200.0,
+                    help="chaos-leg replay speed multiple")
     _add_monitor_flags(pg)
     pg.set_defaults(fn=_cmd_ingest_bench)
 
@@ -1459,6 +1710,62 @@ def main(argv: Optional[List[str]] = None) -> int:
     pb.add_argument("--disk-cache", action="store_true",
                     help="enable the disk cache tier (tempdir)")
     pb.set_defaults(fn=_cmd_serve_bench)
+
+    pc = sub.add_parser(
+        "chaos",
+        help="run a seeded kill/hang schedule against a supervised "
+             "scan or live stream and assert recovery + byte-identity "
+             "(ISSUE 12)",
+    )
+    pc.add_argument("--workload", default="scan",
+                    choices=["scan", "scan-search", "stream"],
+                    help="what to break: a supervised sharded scan, a "
+                         "supervised sharded search, or a live consumer")
+    pc.add_argument("--fault", default="kill", choices=["kill", "hang"],
+                    help="the injected failure mode")
+    pc.add_argument("--after", type=int, default=2,
+                    help="fire after this many windows/chunks")
+    pc.add_argument("--hang-s", type=float, default=60.0,
+                    help="hang duration (must exceed --lease-ttl)")
+    pc.add_argument("--point", default=None,
+                    help="injection point override (default mesh.window "
+                         "for scans, stream.chunk for streams)")
+    pc.add_argument("--victim", type=int, default=0,
+                    help="pod process the schedule targets (scan modes)")
+    pc.add_argument("--procs", type=int, default=2,
+                    help="pod size of the first scan attempt")
+    pc.add_argument("--devices-per-proc", type=int, default=None,
+                    help="chips per simulated host (default: exactly "
+                         "the mesh share, so losing a host forces the "
+                         "pool fallback; set it to the WHOLE mesh to "
+                         "exercise the reshaped-mesh resume instead)")
+    pc.add_argument("--nband", type=int, default=2)
+    pc.add_argument("--nbank", type=int, default=2)
+    pc.add_argument("--nchan", type=int, default=2)
+    pc.add_argument("--nfft", type=int, default=32)
+    pc.add_argument("--nint", type=int, default=1)
+    pc.add_argument("--window-frames", type=int, default=4)
+    pc.add_argument("--window-spectra", type=int, default=4,
+                    help="search window (scan-search workload)")
+    pc.add_argument("--chunks", type=int, default=6,
+                    help="how many windows/chunks the synthetic scan "
+                         "spans")
+    pc.add_argument("--replay-rate", type=float, default=200.0,
+                    help="stream workload replay speed")
+    pc.add_argument("--lease-ttl", type=float, default=3.0,
+                    help="heartbeat lease TTL (the detection budget)")
+    pc.add_argument("--poll", type=float, default=0.1,
+                    help="supervisor watch cadence")
+    pc.add_argument("--attempts", type=int, default=3,
+                    help="recovery attempt budget")
+    pc.add_argument("--seed", type=int, default=0)
+    pc.add_argument("--work-dir", default=None,
+                    help="keep the drill's inputs/products here "
+                         "(default: a fresh temp dir)")
+    pc.add_argument("--json-out", default=None,
+                    help="also write the drill report JSON here "
+                         "(the CI chaos-smoke artifact)")
+    pc.set_defaults(fn=_cmd_chaos)
 
     pt = sub.add_parser(
         "telemetry",

@@ -191,6 +191,24 @@ class SiteConfig:
     slo_fast_window: int = 5
     slo_slow_window: int = 30
     slo_objectives: Optional[List[Dict]] = None
+    # Crash-recovery plane (blit/recover.py; ISSUE 12).  Supervised
+    # sharded scans refresh a per-process heartbeat lease between
+    # windows; a peer whose lease goes stale for recover_lease_ttl_s is
+    # DETECTED (dead via SIGKILL, or wedged in a collective — either
+    # way it stopped making window progress) and the supervisor aborts
+    # the attempt, re-plans on the survivors, and resumes from the
+    # cursors.  recover_poll_s is the supervisor's watch cadence;
+    # recover_max_attempts bounds the abort→re-plan→resume loop;
+    # recover_grace_s is the bring-up budget before a child's FIRST
+    # lease beat (jax import + distributed init — lease staleness is
+    # only judged after a process has beaten once).  Per-process
+    # overrides: BLIT_RECOVER_LEASE_TTL / BLIT_RECOVER_POLL /
+    # BLIT_RECOVER_MAX_ATTEMPTS / BLIT_RECOVER_GRACE
+    # (:func:`recover_defaults`).
+    recover_lease_ttl_s: float = 10.0
+    recover_poll_s: float = 0.2
+    recover_max_attempts: int = 3
+    recover_grace_s: float = 120.0
 
     def io_retry_policy(self):
         """The :class:`blit.faults.RetryPolicy` for worker-side file I/O —
@@ -381,6 +399,23 @@ def slo_defaults(config: SiteConfig = DEFAULT) -> List[Dict]:
                      "budget": config.slo_budget})
     objs.extend(config.slo_objectives or [])
     return objs
+
+
+def recover_defaults(config: SiteConfig = DEFAULT) -> Dict:
+    """The effective crash-recovery knob set (ISSUE 12): ``config``'s
+    values with per-process ``BLIT_RECOVER_*`` environment overrides
+    applied — the :func:`stream_defaults` pattern, resolved at
+    supervisor construction so drills retune per run."""
+    return {
+        "lease_ttl_s": float(os.environ.get(
+            "BLIT_RECOVER_LEASE_TTL", config.recover_lease_ttl_s)),
+        "poll_s": float(os.environ.get(
+            "BLIT_RECOVER_POLL", config.recover_poll_s)),
+        "max_attempts": int(os.environ.get(
+            "BLIT_RECOVER_MAX_ATTEMPTS", config.recover_max_attempts)),
+        "grace_s": float(os.environ.get(
+            "BLIT_RECOVER_GRACE", config.recover_grace_s)),
+    }
 
 
 def default_window_frames(nfft: int) -> int:

@@ -27,7 +27,13 @@ module holds both halves:
   ingest plane's ``stream.chunk`` point (blit/stream; ISSUE 7) —
   ``drop`` (the chunk never arrives: the watermark masks it after the
   lateness budget) and ``dup`` (the chunk is delivered twice: the
-  assembler drops the duplicate).  Rules fire on exact hit
+  assembler drops the duplicate).  The crash-recovery plane (ISSUE 12)
+  adds two process-grade modes for chaos drills at the
+  ``mesh.window`` / ``stream.chunk`` / ``remote.call`` points:
+  ``kill`` (SIGKILL the calling process — the unclean death a
+  :class:`blit.recover.ScanSupervisor` lease detects) and ``hang``
+  (sleep ``hang_s``, default far past any watchdog — the wedged-peer
+  shape that stalls collectives without dying).  Rules fire on exact hit
   counts (``after``/``times``), so a test can target "window 3 of
   antenna 2" and get the same failure every run.  ``BLIT_FAULTS`` in
   the environment arms rules at import time for CLI-level drills (see
@@ -62,7 +68,8 @@ from typing import Callable, Dict, List, Optional
 
 log = logging.getLogger("blit.faults")
 
-MODES = ("fail", "delay", "truncate", "corrupt", "drop", "dup")
+MODES = ("fail", "delay", "truncate", "corrupt", "drop", "dup",
+         "kill", "hang")
 
 
 class InjectedFault(OSError):
@@ -113,9 +120,12 @@ class FaultRule:
 
     ``match`` filters by substring of the call-site key (a file path, a
     host name, an antenna recording path), so a rule can target one
-    antenna of a 64-element array.  ``sleep`` makes ``delay`` rules
-    interruptible/observable in tests.  ``amount`` is the samples cut by
-    ``truncate`` (0 = half the request)."""
+    antenna of a 64-element array.  ``sleep`` makes ``delay`` (and
+    ``hang``) rules interruptible/observable in tests.  ``amount`` is the
+    samples cut by ``truncate`` (0 = half the request); ``hang_s`` is how
+    long a ``hang`` rule sleeps (default: far past any watchdog/lease
+    budget — the chaos drill's wedged-peer shape); ``kill`` lets tests
+    swap the SIGKILL-self of a ``kill`` rule for a recordable callable."""
 
     point: str
     mode: str = "fail"
@@ -125,8 +135,10 @@ class FaultRule:
     exc: type = InjectedFault
     message: str = "injected fault"
     delay_s: float = 0.1
+    hang_s: float = 3600.0
     amount: int = 0
     sleep: Callable[[float], None] = time.sleep
+    kill: Optional[Callable[[], None]] = None
     # Mutable bookkeeping (under the registry lock).
     hits: int = 0
     fired: int = 0
@@ -173,11 +185,31 @@ class _Registry:
                 if r.mode != "delay":
                     break  # first destructive rule wins
         act = None
-        for r in todo:  # apply OUTSIDE the lock (sleep / raise)
+        for r in todo:  # apply OUTSIDE the lock (sleep / raise / kill)
             if r.mode == "delay":
                 log.warning("injected delay %.3fs @ %s [%s]", r.delay_s,
                             point, key)
                 r.sleep(r.delay_s)
+            elif r.mode == "hang":
+                # The chaos drill's wedged peer: alive (the process keeps
+                # its file handles and collective state) but silent far
+                # past any watchdog — detection is the supervisor's job
+                # (lease expiry / window-progress stall), not this rule's.
+                log.error("injected hang %.1fs @ %s [%s]", r.hang_s,
+                          point, key)
+                r.sleep(r.hang_s)
+            elif r.mode == "kill":
+                # The chaos drill's dead peer: SIGKILL-self — no atexit,
+                # no writer close, no lease farewell.  The resumable
+                # writers' fsync-before-claim state is all that survives,
+                # which is exactly the contract the drill asserts.
+                log.error("injected SIGKILL @ %s [%s]", point, key)
+                if r.kill is not None:
+                    r.kill()
+                else:
+                    import signal
+
+                    os.kill(os.getpid(), signal.SIGKILL)
             elif r.mode == "fail":
                 raise r.exc(
                     f"{r.message} @ {point}" + (f" [{key}]" if key else "")
@@ -217,8 +249,10 @@ def fire(point: str, key=None) -> Optional[FaultRule]:
 def parse_spec(spec: str) -> List[FaultRule]:
     """Parse the ``BLIT_FAULTS`` drill grammar: semicolon-separated
     ``point:mode[:times][:k=v...]`` with ``k`` in
-    ``match/after/delay/amount/message`` —
-    e.g. ``"guppi.read:fail:2:match=ant1;remote.call:delay:delay=0.5"``."""
+    ``match/after/delay/hang/amount/message`` —
+    e.g. ``"guppi.read:fail:2:match=ant1;remote.call:delay:delay=0.5"``
+    or, for the chaos drills (ISSUE 12),
+    ``"mesh.window:kill:after=2"`` / ``"mesh.window:hang:hang=60"``."""
     rules = []
     for part in spec.split(";"):
         part = part.strip()
@@ -237,6 +271,8 @@ def parse_spec(spec: str) -> List[FaultRule]:
                 kw[k] = int(v)
             elif k == "delay":
                 kw["delay_s"] = float(v)
+            elif k == "hang":
+                kw["hang_s"] = float(v)
             elif k in ("match", "message"):
                 kw[k] = v
             else:

@@ -658,7 +658,11 @@ class ResumableFBH5Writer(_ChunkStream):
         os.fsync(self._h5.id.get_vfd_handle())
         self._h5.close()
         self._h5 = None
-        sidecar = _cursor_path(self.path)
+        # The cursor names its own sidecar when it can (StreamCursor's
+        # ``.stream-cursor`` sibling, blit/stream/cursor.py); the duck-
+        # typed fallback keeps the ReductionCursor ``.cursor`` default.
+        path_for = getattr(self.cursor, "path_for", _cursor_path)
+        sidecar = path_for(self.path)
         if os.path.exists(sidecar):
             os.unlink(sidecar)
 

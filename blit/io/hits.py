@@ -34,6 +34,33 @@ from typing import Dict, List, Optional, Tuple
 HITS_KIND = "blit.hits"
 HITS_VERSION = 1
 
+# Bound on a resumable writer's per-window claim ledger
+# (``cursor.window_claims`` — ``[window, byte_offset, hits]`` triples):
+# every append re-serializes + fsyncs the whole cursor, so the ledger
+# must not grow with session length.  A restart further back than the
+# trimmed tail is UNRESOLVABLE and refused loudly (never silently
+# mis-resumed) — in practice unreachable: the sharded loop keeps pod
+# claims within the sink depth of each other, orders of magnitude
+# under this bound.
+CLAIM_LEDGER_MAX = 4096
+
+
+def ledger_claim_at(windows: int, windows_done: int, byte_offset: int,
+                    hits_done: int, claims) -> Optional[Tuple[int, int]]:
+    """The ONE ledger-resolution rule both cursor kinds share
+    (SearchCursor / StreamCursor): the head claim resolves directly;
+    earlier windows resolve through a ``[window, byte_offset, hits]``
+    ledger entry; anything else — absent ledger, trimmed-away window —
+    is None (the caller refuses, it never guesses)."""
+    if windows == windows_done:
+        return byte_offset, hits_done
+    if claims is None or windows <= 0:
+        return None
+    for w, off, hits in reversed(claims):
+        if w == windows:
+            return int(off), int(hits)
+    return None
+
 
 def _jsonable(header: Dict) -> Dict:
     import numpy as np
@@ -126,9 +153,39 @@ class ResumableHitsWriter:
         self.path = path
         self.cursor = cursor
         if start_windows > 0 and os.path.exists(path):
+            # The restart may sit EARLIER than this cursor's own claim
+            # (the sharded plane restarts at the pod-wide-agreed minimum,
+            # ISSUE 12): resolve the byte/hit claim at start_windows from
+            # the cursor's per-window ledger and clamp DOWN — truncating
+            # at the cursor's own head claim but calling it start_windows
+            # would splice later windows mid-product.
+            if hasattr(cursor, "claim_at"):
+                claim = cursor.claim_at(start_windows)
+            else:  # ledger-less duck-typed cursor: head claim only
+                claim = ((cursor.byte_offset, cursor.hits_done)
+                         if start_windows == cursor.windows_done
+                         else None)
+            if claim is None:
+                # Refuse LOUDLY: pretending to resume at start_windows
+                # while truncating somewhere else would duplicate (or
+                # drop) windows mid-product — the caller must restart
+                # the player fresh instead.
+                raise ValueError(
+                    f"{path}: cursor cannot resolve a truncation point "
+                    f"for window {start_windows} (claimed "
+                    f"{cursor.windows_done}; claim ledger absent or "
+                    f"trimmed) — delete the sidecar to restart fresh")
+            off, hits = claim
             with open(path, "r+b") as f:
-                f.truncate(cursor.byte_offset)
+                f.truncate(off)
             cursor.windows_done = start_windows
+            cursor.hits_done = hits
+            cursor.byte_offset = off
+            if getattr(cursor, "window_claims", None) is not None:
+                cursor.window_claims = [
+                    e for e in cursor.window_claims
+                    if e[0] <= start_windows
+                ]
             cursor.save(path)
             self._f = open(path, "a")
         else:
@@ -139,6 +196,8 @@ class ResumableHitsWriter:
             cursor.windows_done = 0
             cursor.hits_done = 0
             cursor.byte_offset = self._f.tell()
+            if hasattr(cursor, "window_claims"):
+                cursor.window_claims = []
             cursor.save(path)
         # Cumulative across the whole product, resumed windows included
         # (the ResumableFilWriter nsamps = start_rows convention) — the
@@ -158,6 +217,11 @@ class ResumableHitsWriter:
         self.cursor.windows_done = self.nwindows
         self.cursor.hits_done = self.nsamps
         self.cursor.byte_offset = self._f.tell()
+        claims = getattr(self.cursor, "window_claims", None)
+        if claims is not None:
+            claims.append([self.nwindows, self.cursor.byte_offset,
+                           self.nsamps])
+            del claims[:-CLAIM_LEDGER_MAX]  # bounded per-append I/O
         self.cursor.save(self.path)
 
     def flush(self) -> None:

@@ -579,11 +579,45 @@ class MetricsPublisher:
         return merge_fleet([self.snapshot_dict()])
 
     def health(self) -> Dict:
-        return {"ok": True, "t": self.clock(), "host": hostname(),
+        """The ``/healthz`` body — and it degrades HONESTLY (ISSUE 12
+        satellite): ``status`` is ``"degraded"`` (with machine-readable
+        ``reasons``) whenever a circuit breaker is not fully closed, a
+        recovery supervisor is mid-recovery (health hooks), or an SLO is
+        in fast-burn; ``"ok"`` otherwise.  ``ok`` stays the boolean twin
+        of ``status`` so existing probes keep working."""
+        reasons: List[str] = []
+        breached = self.slo.breached()
+        for name in breached:
+            reasons.append(f"slo-fast-burn:{name}")
+        try:
+            # Lazy import (monitor's import discipline): the pool module
+            # is stdlib + blit.faults/observability/config, never jax.
+            from blit.parallel.pool import current_pool
+
+            pool = current_pool()
+        except Exception:  # noqa: BLE001 — health must not raise
+            pool = None
+        if pool is not None:
+            for row in pool.health():
+                if row.get("state") != "closed":
+                    reasons.append(
+                        f"breaker-{row['state'].replace('-', '_')}:"
+                        f"{row.get('host')}")
+        for name, hook in list(_HEALTH_HOOKS.items()):
+            try:
+                state = hook()
+            except Exception:  # noqa: BLE001 — one bad hook must not
+                continue
+            if state and state.get("degraded"):
+                reasons.append(
+                    f"{name}:{state.get('reason', 'degraded')}")
+        status = "degraded" if reasons else "ok"
+        return {"ok": not reasons, "status": status, "reasons": reasons,
+                "t": self.clock(), "host": hostname(),
                 "pid": os.getpid(), "seq": self.seq,
                 "interval_s": self.interval_s,
                 "watching": len(self._watched),
-                "breached": self.slo.breached(),
+                "breached": breached,
                 "alerts": len(self.slo.alerts)}
 
     @property
@@ -631,6 +665,26 @@ class MetricsPublisher:
 
     def __exit__(self, *exc):
         self.close()
+
+
+# -- health hooks -----------------------------------------------------------
+
+# Named callables other planes register so /healthz can degrade honestly
+# without this module importing them: each returns None/{} when healthy,
+# or {"degraded": True, "reason": "...", ...} while not.  The recovery
+# supervisors (blit/recover.py) register here for the duration of a
+# supervised run.
+_HEALTH_HOOKS: Dict[str, Callable[[], Optional[Dict]]] = {}
+
+
+def register_health_hook(name: str,
+                         hook: Callable[[], Optional[Dict]]) -> None:
+    """Register (or replace) a named /healthz contributor."""
+    _HEALTH_HOOKS[name] = hook
+
+
+def unregister_health_hook(name: str) -> None:
+    _HEALTH_HOOKS.pop(name, None)
 
 
 # -- the process-wide auto-publisher ----------------------------------------

@@ -830,6 +830,12 @@ class StallWatchdog:
         concurrent float stores are atomic in CPython)."""
         self._beat = time.monotonic()
 
+    def age_s(self) -> float:
+        """Seconds since the last beat — the raw staleness the supervisor
+        planes (blit/recover.py) report as detection latency when a
+        watchdog (or its cross-process twin, a heartbeat lease) expires."""
+        return time.monotonic() - self._beat
+
     def poll_s(self, base: float = 0.2) -> float:
         """The poll interval a waiter should use: ``base`` unarmed, else
         clamped so the stall fires within ~half a timeout of reality."""

@@ -183,11 +183,18 @@ class WorkerPool:
     def health(self) -> List[Dict[str, object]]:
         """Per-worker circuit state for the run report: a degraded run
         must SAY so (``state == "open"`` means the host is degraded and
-        calls fail fast until the cooldown probe re-closes it)."""
-        return [
-            {"worker": w.wid, "host": w.host, **w.breaker.snapshot()}
-            for w in self.workers
-        ]
+        calls fail fast until the cooldown probe re-closes it;
+        ``half_open`` marks the probe phase — ONE call is in flight
+        deciding whether the host re-closes or re-trips, and capacity
+        consumers must keep treating it as degraded until it closes,
+        or a recovered-then-flaky host flaps the budget — ISSUE 12
+        satellite)."""
+        out = []
+        for w in self.workers:
+            snap = w.breaker.snapshot()
+            snap["half_open"] = snap["state"] == "half-open"
+            out.append({"worker": w.wid, "host": w.host, **snap})
+        return out
 
     # -- execution --------------------------------------------------------
     def _remote_call(self, w: _Worker, fn: Callable, ctx, /, *args, **kw):

@@ -466,6 +466,22 @@ def _open_band_writers(
                 and cur.window_rows == wrows_ident
                 and os.path.exists(out_paths[b])
             )
+            if ok and not out_paths[b].endswith((".h5", ".hdf5")):
+                # The flat-format crash guard (ISSUE 12): a cursor
+                # claiming bytes the file no longer holds restarts the
+                # band fresh — BEFORE the pod-wide restart agreement, so
+                # every process folds the (now zero) offset symmetrically.
+                from blit.pipeline import resume_fil_ok
+
+                if not resume_fil_ok(out_paths[b], nif, nchans,
+                                     cur.frames_done // nint):
+                    log.warning(
+                        "resume target %s is shorter than (or unreadable "
+                        "as) the cursor's claimed %d frames "
+                        "(crash-corrupted?); restarting the band fresh",
+                        out_paths[b], cur.frames_done,
+                    )
+                    ok = False
             if ok and out_paths[b].endswith((".h5", ".hdf5")):
                 # Crash robustness (ADVICE r5 medium): an HDF5 target a
                 # SIGKILL left unopenable/unreadable restarts this band

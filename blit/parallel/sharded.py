@@ -49,7 +49,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from blit import observability
+from blit import faults, observability
 from blit.monitor import published
 from blit.observability import Timeline, profile_trace
 from blit.ops.channelize import pfb_coeffs, usable_frames
@@ -57,6 +57,7 @@ from blit.parallel import mesh as M
 from blit.parallel.scan import (
     _despike_nfpc,
     _gapless,
+    _gather_int64,
     _open_band_writers,
     _open_players,
     _resolve_grid,
@@ -235,6 +236,7 @@ def reduce_scan_sharded_to_files(
     probe_windows: Optional[int] = None,
     timeline=None,
     trace_logdir: Optional[str] = None,
+    heartbeat=None,
 ) -> Dict[int, Tuple[str, Dict]]:
     """Reduce one scan across the mesh with the fully-threaded sharded
     plane (module docstring) and stream each stitched band to its
@@ -254,6 +256,14 @@ def reduce_scan_sharded_to_files(
     sync the per-chip compute first, so ``mesh.gather_s`` measures the
     all_gather dispatch alone; steady-state windows stay fully
     overlapped and only account ICI bytes.
+
+    ``heartbeat`` (ISSUE 12) is an optional per-window liveness callback
+    ``heartbeat(window_index)``, invoked between windows on the consumer
+    thread — the :class:`blit.recover.ScanSupervisor` passes its lease
+    refresh here, so a peer that stops making window progress (dead OR
+    wedged in a collective) stops beating and the supervisor can detect
+    it from outside the SPMD program.  The ``mesh.window`` fault point
+    fires at the same cadence (``kill``/``hang`` chaos drills).
     """
     import jax.numpy as jnp
 
@@ -330,6 +340,9 @@ def reduce_scan_sharded_to_files(
             sharded=True,
         ), tl.stage("stream"):
             for win in feed.windows():
+                faults.fire("mesh.window", key=f"w{win.index}")
+                if heartbeat is not None:
+                    heartbeat(win.index)
                 with observability.span("mesh.window", i=win.index), \
                         tl.stage("dispatch", byte_free=True):
                     part = M.band_reduce(
@@ -486,11 +499,13 @@ def search_scan_sharded_to_files(
     interpret: bool = False,
     max_frames: Optional[int] = None,
     window_frames: Optional[int] = None,
+    resume: bool = False,
     mesh=None,
     prefetch_depth: Optional[int] = None,
     out_depth: Optional[int] = None,
     timeline=None,
     trace_logdir: Optional[str] = None,
+    heartbeat=None,
 ) -> Dict[Tuple[int, int], Tuple[str, Dict]]:
     """Drift-search one scan across the mesh: every chip channelizes AND
     searches its own ``(band, bank)`` frequency slice in one SPMD window
@@ -510,15 +525,28 @@ def search_scan_sharded_to_files(
     to full windows — the pool path's deterministic trailing-partial
     drop, reproduced exactly.  Returns ``{(band_id, bank):
     (path, header)}`` for the players THIS process wrote.
+
+    ``resume=True`` (ISSUE 12) makes the sharded search crash-resumable,
+    the :class:`~blit.search.dedoppler.SearchCursor` twin of the reduce
+    plane's pod-wide resume: each local player's ``.hits`` carries a
+    cursor sidecar claiming windows only after their lines are fsync'd,
+    the restart window is the pod-wide-agreed MINIMUM across every
+    player (window-aligned — the SPMD loop must restart identically on
+    every process), each file truncates to that window's recorded byte
+    claim (``SearchCursor.window_claims``), and the finished products
+    are byte-identical to an uninterrupted run.  ``heartbeat`` is the
+    per-window liveness callback of the reduce plane (the supervisor's
+    lease refresh); the ``mesh.window`` fault point fires per window.
     """
     import os
 
     import jax  # noqa: F401
     import jax.numpy as jnp
 
-    from blit.io.hits import HitsWriter, WindowHits
+    from blit.io.hits import HitsWriter, ResumableHitsWriter, WindowHits
     from blit.outplane import OutputRotation, readback_extra_slots
-    from blit.search.dedoppler import DedopplerReducer
+    from blit.pipeline import ReductionCursor
+    from blit.search.dedoppler import DedopplerReducer, SearchCursor
     from blit.search.hits import hits_from_packed
 
     band_ids, raw_paths = _resolve_grid(raw_paths, scan, inventories)
@@ -578,16 +606,62 @@ def search_scan_sharded_to_files(
     coeffs = jnp.asarray(pfb_coeffs(ntap, nfft, window))
     jfn = _mesh_dedoppler()
 
+    # Pod-wide-agreed resume point (ISSUE 12): each local player's cursor
+    # names the windows it durably claimed; the restart window is the
+    # MINIMUM across the whole pod, rounded DOWN to whole SCAN windows so
+    # the resumed dispatch shapes match the uninterrupted run's (dispatch
+    # shape is part of the byte-identity contract).  Ledger-less cursors
+    # (pre-window_claims sidecars) cannot truncate to an arbitrary
+    # earlier window, so they count as zero — restart fresh, never splice.
+    start_window = 0
+    cursors: Dict[Tuple[int, int], SearchCursor] = {}
+    if resume:
+        swin = wf // unit  # search windows per scan window
+        local_done = []
+        for bk in local:
+            b, k = bk
+            path = out_paths[b][k]
+            paths_bk = getattr(raws[bk], "paths", None) or raws[bk].path
+            cur = SearchCursor.load(path)
+            ok = (
+                cur is not None
+                and cur.matches(sred, paths_bk)
+                and cur.window_claims is not None
+                and os.path.exists(path)
+                and os.path.getsize(path) >= cur.byte_offset
+            )
+            if not ok:
+                size, mtime_ns = ReductionCursor.stat_raw(paths_bk)
+                cur = SearchCursor(
+                    paths_bk, nfft, ntap, nint, window=window, dtype=dtype,
+                    window_spectra=T, top_k=sred.top_k,
+                    snr_threshold=float(sred.snr_threshold),
+                    max_drift_bins=(
+                        -1 if sred.max_drift_bins is None
+                        else int(sred.max_drift_bins)
+                    ),
+                    raw_size=size, raw_mtime_ns=mtime_ns,
+                    window_claims=[],
+                )
+            cursors[bk] = cur
+            local_done.append(cur.windows_done if ok else 0)
+        local_min = min(local_done) if local_done else 1 << 61
+        agreed = int(_gather_int64(
+            np.asarray([local_min], np.int64)
+        ).min())
+        start_window = min((agreed // swin) * swin, nwin_total)
+
     tl = timeline if timeline is not None else Timeline()
     feed = _ShardFeed(
         raws, local, mesh, nchan, npol, nfft=nfft, ntap=ntap, wf=wf,
-        total=total, f0_start=0, timeline=tl, prefetch_depth=prefetch,
+        total=total, f0_start=start_window * unit, timeline=tl,
+        prefetch_depth=prefetch,
         extra_slots=readback_extra_slots(depth, prefetch),
     )
     rot = OutputRotation(depth=depth, timeline=tl, reuse=False,
                          name="blit-mesh-search-readback")
     writers = {}
-    nwindows = {bk: 0 for bk in local}
+    nwindows = {bk: start_window for bk in local}
 
     def route(slab) -> None:
         widx, bk = slab.payload
@@ -600,11 +674,18 @@ def search_scan_sharded_to_files(
     try:
         for bk in local:
             b, k = bk
-            writers[bk] = HitsWriter(out_paths[b][k], hdrs[bk])
+            if resume:
+                writers[bk] = ResumableHitsWriter(
+                    out_paths[b][k], hdrs[bk], start_window, cursors[bk])
+            else:
+                writers[bk] = HitsWriter(out_paths[b][k], hdrs[bk])
         with profile_trace(trace_logdir), observability.span(
             "mesh.search", nfft=nfft, nband=nband, nbank=nbank,
         ), tl.stage("stream"):
             for win in feed.windows():
+                faults.fire("mesh.window", key=f"w{win.index}")
+                if heartbeat is not None:
+                    heartbeat(win.index)
                 with observability.span("mesh.window", i=win.index), \
                         tl.stage("dispatch", byte_free=True):
                     part = M.band_reduce(

@@ -1074,6 +1074,23 @@ class RawReducer:
                     out_path, cur.frames_done,
                 )
                 resuming = False
+        if resuming and not is_h5:
+            # The flat-format twin (ISSUE 12 satellite): a cursor claiming
+            # bytes the file no longer holds must restart fresh — the
+            # writer's truncate-to-claim would otherwise EXTEND the short
+            # file with a NUL hole and finish an unreadable product.
+            from blit.ops.narrow import NARROW_DTYPES
+
+            if not resume_fil_ok(
+                out_path, nif, hdr["nchans"], cur.frames_done // self.nint,
+                dtype=NARROW_DTYPES[self.nbits],
+            ):
+                log.warning(
+                    "resume target %s is shorter than (or unreadable as) "
+                    "the cursor's claimed %d frames (crash-corrupted?); "
+                    "starting fresh", out_path, cur.frames_done,
+                )
+                resuming = False
         if resuming:
             log.info("resuming %s at frame %d", out_path, cur.frames_done)
         else:
@@ -1110,6 +1127,26 @@ class RawReducer:
             hdr["nsamps"] = self._pump(raw, w,
                                        skip_frames=start_rows * self.nint)
         return hdr
+
+
+def resume_fil_ok(path: str, nif: int, nchans: int, rows: int,
+                  dtype=np.float32) -> bool:
+    """May a ``.fil`` resume target honor a cursor claiming ``rows``
+    spectra?  The file must parse a SIGPROC header AND hold at least the
+    claimed bytes: :class:`ResumableFilWriter` truncates *down* to the
+    claim, and POSIX ``truncate`` on a SHORTER file would silently
+    EXTEND it with a NUL hole — a crash-corrupted (or replaced) product
+    must restart fresh instead (the ``resume_target_ok`` discipline of
+    blit/io/fbh5.py, applied to the flat format; ISSUE 12 satellite)."""
+    from blit.io.sigproc import read_fil_header
+
+    try:
+        _, off = read_fil_header(path)
+        size = os.path.getsize(path)
+    except (OSError, ValueError):
+        return False
+    need = off + rows * nif * nchans * np.dtype(dtype).itemsize
+    return size >= need
 
 
 class ResumableFilWriter:
@@ -1169,9 +1206,11 @@ class ResumableFilWriter:
         self.cursor.save(self.path)
 
     def close(self) -> None:
-        """Finish: the sidecar's absence is the completeness marker."""
+        """Finish: the sidecar's absence is the completeness marker.
+        The cursor names its own sidecar path — StreamCursor rides this
+        writer with a ``.stream-cursor`` sibling (blit/stream/cursor.py)."""
         self._f.close()
-        sidecar = ReductionCursor.path_for(self.path)
+        sidecar = self.cursor.path_for(self.path)
         if os.path.exists(sidecar):
             os.unlink(sidecar)
 

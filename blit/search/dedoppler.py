@@ -511,6 +511,29 @@ class SearchCursor:
     byte_offset: int = 0
     raw_size: Union[int, List[int]] = -1
     raw_mtime_ns: Union[int, List[int]] = -1
+    # Per-window ``[window, byte_offset, hits]`` claims, appended as
+    # each window is claimed (ISSUE 12): windows are RAGGED — a
+    # zero-hit window leaves no line — so a resume at an EARLIER window
+    # than this cursor's own claim (the sharded plane's pod-wide-agreed
+    # minimum) can only find its truncation point here.  The ledger is
+    # BOUNDED (blit/io/hits.py trims to the newest CLAIM_LEDGER_MAX
+    # entries — per-append cursor I/O must not grow with session
+    # length); a window older than the tail resolves to None and that
+    # player restarts fresh.  None (pre-existing sidecars) = resumable
+    # only at the exact claimed window, the old behavior.
+    window_claims: Optional[List[List[int]]] = None
+
+    def claim_at(self, windows: int) -> Optional[Tuple[int, int]]:
+        """The ``(byte_offset, hits_done)`` claim after ``windows`` full
+        windows, when this cursor recorded it (``windows`` == the full
+        claim always resolves; earlier windows need a ``window_claims``
+        ledger entry) — :func:`blit.io.hits.ledger_claim_at`, the rule
+        shared with :class:`blit.stream.cursor.StreamCursor`."""
+        from blit.io.hits import ledger_claim_at
+
+        return ledger_claim_at(windows, self.windows_done,
+                               self.byte_offset, self.hits_done,
+                               self.window_claims)
 
     # One sidecar persistence protocol, shared with the pipeline cursor
     # (ReductionCursor's save/load operate on self.__dict__ / cls(**...),

@@ -66,14 +66,21 @@ class Job:
 
     __slots__ = ("fn", "priority", "client", "deadline_s", "submitted_at",
                  "started_at", "finished_at", "state", "_result", "_exc",
-                 "_done")
+                 "_done", "held")
 
     def __init__(self, fn: Callable[[], object], priority: int, client: str,
-                 deadline_s: Optional[float], now: float):
+                 deadline_s: Optional[float], now: float,
+                 held: bool = False):
         self.fn = fn
         self.priority = priority
         self.client = client
         self.deadline_s = deadline_s
+        # Session-length capacity hold (ISSUE 12 satellite): a live job
+        # that runs for the recording's duration, not a bounded
+        # reduction — it consumes a concurrency slot but is EXCLUDED
+        # from the EWMA service model and the deadline estimator's
+        # work-ahead count (an unbounded job would poison both).
+        self.held = held
         self.submitted_at = now
         self.started_at: Optional[float] = None
         self.finished_at: Optional[float] = None
@@ -140,6 +147,16 @@ class Scheduler:
         self._rr: Dict[int, Deque[str]] = {}
         self._queued: Dict[int, int] = {}
         self._running = 0
+        # Capacity currently held by session-length (unbounded) jobs —
+        # a subset of _running, reported via held()/stats so operators
+        # see how much budget live sessions pin (ISSUE 12 satellite).
+        # _held_queued tracks hold jobs still WAITING for a slot, PER
+        # PRIORITY: they too must stay out of the deadline estimator's
+        # work-ahead (a queued session is not "one EWMA-length job
+        # ahead of you"), and the subtraction must follow the same
+        # priority filter as the queue sum it corrects.
+        self._held = 0
+        self._held_queued: Dict[int, int] = {}
         self._closed = False
         # EWMA of job service seconds — the wait estimator's unit cost.
         self._svc_ewma = 0.0
@@ -195,7 +212,11 @@ class Scheduler:
         total = len(health)
         if total == 0:
             return base
-        healthy = sum(1 for h in health if h.get("state") != "open")
+        # Only a fully CLOSED breaker restores budget: a half-open host
+        # is still degraded (one probe call is deciding its fate), so a
+        # recovered-then-flaky host re-trips without ever having flapped
+        # the budget back up (ISSUE 12 satellite).
+        healthy = sum(1 for h in health if h.get("state") == "closed")
         return max(1, (base * healthy) // total)
 
     def depth(self) -> int:
@@ -206,6 +227,12 @@ class Scheduler:
     def running(self) -> int:
         with self._lock:
             return self._running
+
+    def held(self) -> int:
+        """Concurrency slots pinned by session-length capacity holds
+        (running jobs submitted with ``hold=True``)."""
+        with self._lock:
+            return self._held
 
     def est_wait_s(self, priority: int) -> float:
         """Expected queue wait for a NEW job at ``priority``.
@@ -220,19 +247,35 @@ class Scheduler:
         empty scheduler's history predicts nothing about an empty
         queue."""
         with self._lock:
-            ahead = self._running + sum(
-                n for p, n in self._queued.items() if p <= priority
+            # Session-length holds are NOT work ahead — they never
+            # finish "soon", so counting them (running OR still queued)
+            # would reject every deadline the moment a live session
+            # attaches.  They do pin capacity, which the budget term
+            # below accounts.
+            ahead = max(
+                0,
+                (self._running - self._held) + sum(
+                    n for p, n in self._queued.items() if p <= priority
+                ) - sum(n for p, n in self._held_queued.items()
+                        if p <= priority),
             )
+            held = self._held
             svc = self._svc_ewma
             n = self.wait_hist.n
             p99 = (self.wait_hist.percentile(0.99)
                    if n >= self.wait_est_floor else None)
+        budget_free = self.effective_budget() - held
+        if budget_free <= 0:
+            # EVERY slot is pinned by session-length holds: bounded
+            # work cannot start until a session ends, which the
+            # estimator cannot bound — infinite, so deadline admission
+            # rejects at the door instead of queueing a dead promise.
+            return float("inf")
         if ahead == 0:
             return 0.0
         if p99 is not None:
             return p99
-        budget = self.effective_budget()
-        return (ahead * svc) / max(1, budget)
+        return (ahead * svc) / budget_free
 
     # -- submission --------------------------------------------------------
     def submit(
@@ -242,12 +285,21 @@ class Scheduler:
         priority: int = 1,
         client: str = "anon",
         deadline_s: Optional[float] = None,
+        hold: bool = False,
     ) -> Job:
         """Admit ``fn`` for execution, or raise :class:`Overloaded`.
 
         ``deadline_s`` is the caller's patience: a job whose estimated
         queue wait already exceeds it is rejected at the door (the caller
-        finds out NOW, not after the deadline burned in a queue)."""
+        finds out NOW, not after the deadline burned in a queue).
+
+        ``hold=True`` declares a session-length capacity hold (a LIVE
+        job, ISSUE 12 satellite): the job consumes a concurrency slot
+        for as long as the session records, but its (unbounded) service
+        time never feeds the EWMA model and it is excluded from the
+        deadline estimator's work-ahead count — the scheduler stops
+        assuming bounded jobs.  ``held()``/``stats()`` report the
+        pinned capacity."""
         if self._closed:
             raise RuntimeError("scheduler is closed")
         now = self.clock()
@@ -271,7 +323,7 @@ class Scheduler:
                     f"deadline {deadline_s:.3f}s unmeetable: estimated "
                     f"queue wait {est:.3f}s", retry_after_s=max(0.1, est),
                 )
-            job = Job(fn, priority, client, deadline_s, now)
+            job = Job(fn, priority, client, deadline_s, now, held=hold)
             per_client = self._queues.setdefault(priority, {})
             q = per_client.get(client)
             if q is None:
@@ -281,6 +333,9 @@ class Scheduler:
                 self._rr[priority].append(client)
             q.append(job)
             self._queued[priority] = self._queued.get(priority, 0) + 1
+            if job.held:
+                self._held_queued[priority] = (
+                    self._held_queued.get(priority, 0) + 1)
             self.counts["submitted"] += 1
             self.timeline.gauge("sched.queue_depth",
                                 sum(self._queued.values()))
@@ -320,6 +375,10 @@ class Scheduler:
             job.state = "running"
             job.started_at = self.clock()
             self._running += 1
+            if job.held:
+                self._held_queued[job.priority] -= 1
+                self._held += 1
+                self.timeline.gauge("sched.held", self._held)
             self.counts["dispatched"] += 1
             wait = job.started_at - job.submitted_at
             self.wait_hist.observe(wait)
@@ -345,13 +404,22 @@ class Scheduler:
         finally:
             dt = time.perf_counter() - t0
             with self._lock:
-                # EWMA toward recent service times (alpha 0.3), seeded by
-                # the first observation — the wait estimator's unit cost.
-                self._svc_n += 1
-                self._svc_ewma = (
-                    dt if self._svc_n == 1
-                    else 0.7 * self._svc_ewma + 0.3 * dt
-                )
+                if job.held:
+                    # A session's duration is the RECORDING's, not the
+                    # machinery's: folding it into the EWMA would make
+                    # the deadline estimator reject every bounded job
+                    # after one long session (ISSUE 12 satellite).
+                    self._held -= 1
+                    self.timeline.gauge("sched.held", self._held)
+                else:
+                    # EWMA toward recent service times (alpha 0.3),
+                    # seeded by the first observation — the wait
+                    # estimator's unit cost.
+                    self._svc_n += 1
+                    self._svc_ewma = (
+                        dt if self._svc_n == 1
+                        else 0.7 * self._svc_ewma + 0.3 * dt
+                    )
                 self._running -= 1
                 self.timeline.gauge("sched.running", self._running)
                 job.state = "done"
@@ -373,6 +441,8 @@ class Scheduler:
                 return False
             q.remove(job)
             self._queued[job.priority] -= 1
+            if job.held:
+                self._held_queued[job.priority] -= 1
             job.state = "cancelled"
             self.counts["cancelled"] += 1
             self.timeline.count("sched.cancelled")

@@ -63,7 +63,21 @@ class ProductRequest:
     returned header).  The search knobs join the fingerprint, so cached
     ``.hits`` and ``.fil`` products of the same recording never collide,
     and identical concurrent searches single-flight like any other
-    request."""
+    request.
+
+    ``kind="stream"`` admits a LIVE job (ISSUE 12 satellite, ROADMAP
+    item 5): ``raw`` names a recording still being written, ``out`` the
+    product path, and the job runs :func:`blit.stream.stream_reduce`
+    (rejoinable, ``resume=True``) for the SESSION's duration.
+    The scheduler admits the job under a capacity HOLD — it pins a
+    concurrency slot but is excluded from the EWMA/deadline model,
+    which assumes bounded jobs; ``session_s`` declares the expected
+    session length, reported through ``stats()["held_declared_s"]`` so
+    operators see how long the pin expects to last.  Live sessions are
+    never cached or coalesced, and a second ask for an in-flight
+    ``out`` is rejected (the bytes are still growing; the product
+    lands on disk at ``out``) — the result tuple is ``(header, empty
+    array)``."""
 
     raw: Union[str, Tuple[str, ...]]
     product: Optional[str] = None
@@ -72,13 +86,21 @@ class ProductRequest:
     stokes: str = "I"
     fqav_by: int = 1
     dtype: str = "float32"
-    # Product kind: "filterbank" (default) | "hits" (drift search).
+    # Product kind: "filterbank" (default) | "hits" (drift search) |
+    # "stream" (live session, capacity-held).
     kind: str = "filterbank"
     # Search knobs (kind="hits" only; None -> SiteConfig/env defaults).
     window_spectra: Optional[int] = None
     snr_threshold: Optional[float] = None
     top_k: Optional[int] = None
     max_drift_bins: Optional[int] = None
+    # Live-job knobs (kind="stream" only): product path, declared
+    # session length (capacity-hold accounting), and the tail/replay
+    # shaping passed through to stream_reduce's source.
+    out: Optional[str] = None
+    session_s: Optional[float] = None
+    replay_rate: Optional[float] = None
+    idle_timeout_s: Optional[float] = None
 
     def __post_init__(self):
         if isinstance(self.raw, list):
@@ -87,7 +109,7 @@ class ProductRequest:
             raise ValueError(
                 "pass either product= or explicit nfft/nint, not both"
             )
-        if self.kind not in ("filterbank", "hits"):
+        if self.kind not in ("filterbank", "hits", "stream"):
             raise ValueError(f"unknown product kind {self.kind!r}")
         if self.kind != "hits" and any(
             v is not None for v in (self.window_spectra, self.snr_threshold,
@@ -99,6 +121,18 @@ class ProductRequest:
                 "hits products search the Stokes-I stream un-averaged "
                 "(stokes='I', fqav_by=1)"
             )
+        if self.kind == "stream":
+            if self.out is None:
+                raise ValueError("kind='stream' needs out= (the live "
+                                 "product's path)")
+            if isinstance(self.raw, tuple):
+                raise ValueError("a live session tails ONE growing "
+                                 "recording (a .NNNN.raw member path)")
+        elif any(v is not None for v in (self.out, self.session_s,
+                                         self.replay_rate,
+                                         self.idle_timeout_s)):
+            raise ValueError("out/session_s/replay_rate/idle_timeout_s "
+                             "require kind='stream'")
 
     def reducer(self):
         """The configured reducer for this ask: a
@@ -106,6 +140,17 @@ class ProductRequest:
         :class:`blit.search.dedoppler.DedopplerReducer` for hits — both
         expose ``reduce(raw) -> (header, array)`` and the fingerprint
         knob surface, so the service treats them alike."""
+        if self.kind == "stream":
+            # The live job's reducer is a plain RawReducer (the stream
+            # plane feeds the unchanged batch reducers); constructed
+            # here so the service treats its knobs like any other's.
+            from blit.pipeline import RawReducer, reducer_for_product
+
+            kw = dict(stokes=self.stokes, fqav_by=self.fqav_by,
+                      dtype=self.dtype)
+            if self.product is not None:
+                return reducer_for_product(self.product, **kw)
+            return RawReducer(nfft=self.nfft, nint=self.nint, **kw)
         if self.kind == "hits":
             from blit.pipeline import PRODUCT_PRESETS
             from blit.search import DedopplerReducer
@@ -199,6 +244,10 @@ class ProductService:
         )
         self._lock = threading.Lock()
         self._flights: Dict[str, _Flight] = {}
+        # In-flight live sessions' DECLARED lengths (kind="stream"
+        # session_s; None = undeclared) — the operator-facing view of
+        # how long the held capacity expects to stay pinned (stats()).
+        self._live_declared: Dict[str, Optional[float]] = {}
         self.counts: Dict[str, int] = {
             "requests": 0, "coalesced": 0, "cache_hits": 0,
             "scheduled": 0, "rejected": 0,
@@ -230,6 +279,15 @@ class ProductService:
         :class:`~blit.serve.scheduler.Overloaded` when admission control
         refuses, and ``OSError`` when the raw input does not exist (an
         address over unknown bytes is a caller bug, found at the door)."""
+        if request.kind == "stream":
+            if deadline_s is not None:
+                # The deadline estimator models BOUNDED jobs; silently
+                # queueing a session past a caller's deadline would be
+                # the un-honored contract, so refuse loudly instead.
+                raise ValueError(
+                    "deadline_s does not apply to kind='stream' live "
+                    "sessions (they run for the recording's duration)")
+            return self._submit_stream(request, priority, client)
         reducer = request.reducer()
         fp = fingerprint_for(reducer, request.raw_source)
         with self._lock:
@@ -279,6 +337,78 @@ class ProductService:
             self.counts["scheduled"] += 1
         return t
 
+    def _submit_stream(self, request: ProductRequest, priority: int,
+                       client: str) -> Ticket:
+        """Admit a LIVE job (ISSUE 12 satellite): no cache hit is
+        possible over still-growing bytes and no coalescing is safe —
+        two live consumers of one session would interleave appends on
+        ONE product path and its rejoin sidecar — so a second ask for
+        an in-flight ``out`` is REJECTED with :class:`Overloaded`
+        (retry once the session ends; a crashed session's restart goes
+        through `blit.recover.StreamSupervisor`, not a duplicate
+        submit).  Admitted sessions go straight to the scheduler under
+        a session-length capacity hold."""
+        fp = f"live:{request.out}"
+        with self._lock:
+            self.counts["requests"] += 1
+            if fp in self._flights:
+                self.counts["rejected"] += 1
+                raise Overloaded(
+                    f"live session already in flight for {request.out}; "
+                    "retry after it ends")
+            flight = _Flight(fp)
+            t = Ticket(fp, client, "scheduled", _flight=flight)
+            flight.tickets.append(t)
+            self._flights[fp] = flight
+            ctx = observability.tracer().context()
+            try:
+                flight.job = self.scheduler.submit(
+                    lambda: self._run_stream(request, flight, ctx),
+                    priority=priority, client=client, hold=True,
+                )
+            except BaseException as e:
+                del self._flights[fp]  # the bounded-path leak rule
+                if isinstance(e, Overloaded):
+                    self.counts["rejected"] += 1
+                raise
+            self._live_declared[fp] = request.session_s
+            self.counts["scheduled"] += 1
+            self.timeline.count("serve.live_sessions")
+        return t
+
+    def _run_stream(self, request: ProductRequest, flight: _Flight,
+                    ctx=None) -> Tuple[Dict, np.ndarray]:
+        tr = observability.tracer()
+        try:
+            with tr.activate(ctx), \
+                    tr.span("serve.stream", out=request.out), \
+                    self.timeline.stage("serve.stream", byte_free=True):
+                from blit.stream import (
+                    FileTailSource,
+                    ReplaySource,
+                    stream_reduce,
+                )
+
+                reducer = request.reducer()
+                if request.replay_rate:
+                    src = ReplaySource(request.raw,
+                                       rate=request.replay_rate)
+                else:
+                    src = FileTailSource(
+                        request.raw,
+                        idle_timeout_s=request.idle_timeout_s)
+                hdr = stream_reduce(src, request.out, reducer=reducer,
+                                    resume=True)
+            data = np.zeros(
+                (0, int(hdr.get("nifs", 1)), int(hdr.get("nchans", 0))),
+                np.float32)
+            data.setflags(write=False)
+            self._finish(flight.fingerprint, flight, result=(hdr, data))
+            return hdr, data
+        except BaseException as e:  # noqa: BLE001 — per-ticket delivery
+            self._finish(flight.fingerprint, flight, exc=e)
+            raise
+
     def _reduce_and_publish(
         self, fp: str, request: ProductRequest, flight: _Flight, ctx=None
     ) -> Tuple[Dict, np.ndarray]:
@@ -324,6 +454,7 @@ class ProductService:
         with self._lock:
             if self._flights.get(fp) is flight:
                 del self._flights[fp]
+            self._live_declared.pop(fp, None)
             flight.result = result
             flight.exc = exc
         flight.done.set()
@@ -408,6 +539,15 @@ class ProductService:
         out["queue_wait"] = self.scheduler.wait_percentiles()
         out["budget"] = self.scheduler.effective_budget()
         out["shed"] = self.scheduler.shed_level()
+        # Capacity pinned by live sessions (ISSUE 12 satellite): the
+        # held slots are budget the bounded-job estimator cannot use;
+        # held_declared_s totals the in-flight sessions' DECLARED
+        # lengths (session_s) so an operator sees how long that pin
+        # expects to last.
+        out["held"] = self.scheduler.held()
+        with self._lock:
+            out["held_declared_s"] = sum(
+                s for s in self._live_declared.values() if s)
         return out
 
     def close(self, timeout: Optional[float] = 30.0) -> None:

@@ -14,6 +14,7 @@ The golden contract: streaming a fully-recorded file through
 ``.fil``/``.h5``/``.hits`` products to the batch path.
 """
 
+from blit.stream.cursor import StreamCursor
 from blit.stream.plane import LiveRawStream, stream_reduce, stream_search
 from blit.stream.source import (
     ChunkSource,
@@ -31,6 +32,7 @@ __all__ = [
     "QueueSource",
     "ReplaySource",
     "StreamChunk",
+    "StreamCursor",
     "chunks_of",
     "stream_reduce",
     "stream_search",

@@ -1,0 +1,171 @@
+"""Live-session rejoin state (ISSUE 12): the :class:`StreamCursor`.
+
+A live consumer that restarts mid-session (crashed process, rolling
+deploy, OOM kill) must RE-ATTACH to the still-recording session and
+finish a product byte-identical to a never-restarted consumer — the
+streaming twin of :class:`blit.pipeline.ReductionCursor` /
+:class:`blit.search.dedoppler.SearchCursor`.  Three things make a live
+resume different from a batch one, and all three live here:
+
+- **identity is the session, not the bytes.**  The recording is still
+  growing, so size/mtime guards would reject every legitimate rejoin.
+  The cursor binds to the session *path* plus every output-affecting
+  knob instead; a changed recording path or config restarts fresh.
+
+- **mask state must survive.**  A chunk the watermark masked before the
+  crash was already folded (as zeros) into claimed product rows — and
+  its data may well exist on disk by the time the restarted consumer
+  re-reads the session.  The cursor persists every masked seat, and the
+  restarted :class:`~blit.stream.plane.LiveRawStream` re-masks them
+  (``premasked=``), counting any now-available data as late — exactly
+  what the never-restarted consumer did.
+
+- **the claim is the product's, not the feed's.**  ``frames_done`` (or,
+  for ``.hits``, ``windows_done``/``byte_offset`` plus the per-window
+  ``window_claims`` ledger) counts output durably fsync'd BEFORE the
+  cursor claimed it — the ResumableFilWriter/ResumableHitsWriter
+  ordering — so a restarted consumer truncates any un-checkpointed tail
+  and replays it from the re-read session bytes, bit-identically.
+
+The sidecar lives at ``<product>.stream-cursor`` (NOT ``.cursor``: a
+stream product and a batch resume of the same path must never parse
+each other's state), written with the same tmp-fsync-rename protocol as
+the batch cursors, and removed on clean completion.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+log = logging.getLogger("blit.stream")
+
+
+@dataclass
+class StreamCursor:
+    """Rejoin state for one live product (module docstring)."""
+
+    path: str                 # the SESSION's path (source.path)
+    kind: str                 # "filterbank" | "hits"
+    nfft: int
+    ntap: int = 4
+    nint: int = 1
+    stokes: str = "I"
+    window: str = "hamming"
+    fqav_by: int = 1
+    dtype: str = "float32"
+    nbits: int = 32
+    # The affine quantize rule changes every nbits<32 product byte —
+    # identity, like nbits itself (the ReductionCursor rule).
+    quant_scale: float = 1.0
+    quant_offset: float = 0.0
+    compression: str = "none"
+    # Search identity (kind="hits"; -1 = not applicable).
+    window_spectra: int = -1
+    top_k: int = -1
+    snr_threshold: float = -1.0
+    max_drift_bins: int = -1
+    # Progress claims (fsync-before-claim — see module docstring).
+    frames_done: int = 0      # filterbank: raw PFB frames written
+    windows_done: int = 0     # hits: search windows written
+    hits_done: int = 0
+    byte_offset: int = 0
+    window_claims: Optional[List[List[int]]] = None
+    # The degradation ledger: every seat the watermark masked, in seq
+    # order — re-masked verbatim on rejoin.
+    masked_chunks: List[int] = field(default_factory=list)
+
+    @staticmethod
+    def path_for(out_path: str) -> str:
+        return out_path + ".stream-cursor"
+
+    def save(self, out_path: str) -> None:
+        tmp = self.path_for(out_path) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.__dict__, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path_for(out_path))
+
+    @classmethod
+    def load(cls, out_path: str) -> Optional["StreamCursor"]:
+        try:
+            with open(cls.path_for(out_path)) as f:
+                return cls(**json.load(f))
+        except (OSError, ValueError, TypeError):
+            return None
+
+    def claim_at(self, windows: int) -> Optional[Tuple[int, int]]:
+        """``(byte_offset, hits_done)`` after ``windows`` full windows —
+        :func:`blit.io.hits.ledger_claim_at`, the one ledger-resolution
+        rule shared with :class:`blit.search.dedoppler.SearchCursor`."""
+        from blit.io.hits import ledger_claim_at
+
+        return ledger_claim_at(windows, self.windows_done,
+                               self.byte_offset, self.hits_done,
+                               self.window_claims)
+
+    def matches(self, red, session_path: str, kind: str,
+                compression: Optional[str] = None) -> bool:
+        """Does this cursor describe the same session reduced the same
+        way?  ``red`` is the configured reducer (RawReducer or
+        DedopplerReducer); every output-affecting knob must match — a
+        mismatch splices different spectra into one product."""
+        if self.path != session_path or self.kind != kind:
+            return False
+        if self.compression != (compression or "none"):
+            return False
+        same = (
+            self.nfft == red.nfft
+            and self.ntap == red.ntap
+            and self.nint == red.nint
+            and self.stokes == getattr(red, "stokes", "I")
+            and self.window == red.window
+            and self.fqav_by == getattr(red, "fqav_by", 1)
+            and self.dtype == red.dtype
+            and self.nbits == getattr(red, "nbits", 32)
+            and self.quant_scale == getattr(red, "quant_scale", 1.0)
+            and self.quant_offset == getattr(red, "quant_offset", 0.0)
+        )
+        if not same:
+            return False
+        if kind == "hits":
+            return (
+                self.window_spectra == red.window_spectra
+                and self.top_k == red.top_k
+                and self.snr_threshold == float(red.snr_threshold)
+                and self.max_drift_bins == (
+                    -1 if red.max_drift_bins is None
+                    else int(red.max_drift_bins)
+                )
+            )
+        return True
+
+    @classmethod
+    def fresh(cls, red, session_path: str, kind: str,
+              compression: Optional[str] = None) -> "StreamCursor":
+        """A zero-progress cursor for ``red`` over ``session_path``."""
+        kw = dict(
+            path=session_path, kind=kind, nfft=red.nfft, ntap=red.ntap,
+            nint=red.nint, stokes=getattr(red, "stokes", "I"),
+            window=red.window, fqav_by=getattr(red, "fqav_by", 1),
+            dtype=red.dtype, nbits=getattr(red, "nbits", 32),
+            quant_scale=getattr(red, "quant_scale", 1.0),
+            quant_offset=getattr(red, "quant_offset", 0.0),
+            compression=compression or "none",
+        )
+        if kind == "hits":
+            kw.update(
+                window_spectra=int(red.window_spectra),
+                top_k=int(red.top_k),
+                snr_threshold=float(red.snr_threshold),
+                max_drift_bins=(
+                    -1 if red.max_drift_bins is None
+                    else int(red.max_drift_bins)
+                ),
+                window_claims=[],
+            )
+        return cls(**kw)

@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import bisect
 import logging
+import os
 import time
 from typing import Dict, Iterator, List, Optional
 
@@ -69,6 +70,7 @@ class LiveRawStream:
                  lateness_s: Optional[float] = None,
                  stall_timeout_s: Optional[float] = None,
                  timeline: Optional[Timeline] = None,
+                 premasked=None,
                  clock=time.monotonic, sleep=time.sleep,
                  config: SiteConfig = DEFAULT):
         d = stream_defaults(config)
@@ -98,6 +100,13 @@ class LiveRawStream:
         # stream_report() merge puts them on the product header.
         self.masked_chunks: set = set()
         self.mask_header: Dict = {}
+        # Rejoin state (ISSUE 12): seats a PREVIOUS consumer's watermark
+        # already masked (persisted in the StreamCursor).  They were
+        # folded as zeros into rows the product already claims, so a
+        # restarted consumer must re-mask them unconditionally — even if
+        # the recorder's bytes exist on disk by now; such data counts
+        # late, exactly as a straggler after a live mask would.
+        self._premasked: set = set(premasked or ())
         self.late_chunks = 0
         self.dup_chunks = 0
         self.chunks_in = 0
@@ -108,6 +117,11 @@ class LiveRawStream:
         # see class docstring).  Masked spans feed degraded_rows().
         self._marks: List[tuple] = []
         self.masked_spans: List[tuple] = []
+        # (seq, sample_a, sample_b) per masked seat, append-only like
+        # _marks — the sink-thread-safe view the rejoin cursor persists
+        # (reading the masked_chunks SET cross-thread would race its
+        # producer-side mutation).
+        self._masked_log: List[tuple] = []
         self._cum = 0
 
     # -- receipt + watermark ----------------------------------------------
@@ -215,6 +229,20 @@ class LiveRawStream:
         lateness budget, overdue seats masked, duplicates/stragglers
         dropped — until end-of-stream."""
         while True:
+            if self._next in self._premasked:
+                # A seat the pre-crash consumer already masked: re-mask
+                # it without waiting out the watermark (the decision was
+                # made — and claimed into the product — last run), and
+                # drop any now-available data as late.
+                c = self._pending.pop(self._next, None)
+                if c is not None:
+                    self.late_chunks += 1
+                    self.timeline.count("stream.chunk.late")
+                    observability.flight_recorder().event(
+                        "stream", "chunk.late", seq=c.seq, remask=True)
+                self.timeline.count("stream.chunk.remask")
+                yield self._mask_next(self._clock())
+                continue
             if self._next in self._pending:
                 c = self._pending.pop(self._next)
                 self._next += 1
@@ -290,6 +318,7 @@ class LiveRawStream:
         self._marks.append((self._cum, c.t_arrival))
         if c.masked:
             self.masked_spans.append((a, self._cum))
+            self._masked_log.append((c.seq, a, self._cum))
         if c.masked:
             def read_into(dst, t0, take):
                 dst[:, :take] = 0
@@ -361,17 +390,58 @@ class _LatencyTap:
     def __init__(self, writer, live: LiveRawStream, timeline: Timeline,
                  *, nfft: int, ntap: int, nint: int,
                  window_spectra: Optional[int] = None,
-                 clock=time.monotonic):
+                 clock=time.monotonic, cursor=None, heartbeat=None,
+                 start_rows: int = 0):
         self._w = writer
         self._live = live
         self._tl = timeline
         self._nfft, self._ntap, self._nint = nfft, ntap, nint
         self._T = window_spectra
-        self._rows = 0
+        self._rows = start_rows
         self._clock = clock
+        self._cursor = cursor
+        self._hb = heartbeat
+        # Monotone prune index into the live feed's _masked_log: spans
+        # land in increasing sample order and the claim frontier only
+        # advances, so entries once behind the cut never need
+        # re-scanning — per-append mask bookkeeping is O(new masks),
+        # not O(session degradation history).
+        self._mask_lo = 0
         self.path = getattr(writer, "path", None)
 
     def append(self, item) -> None:
+        if self._cursor is not None:
+            # Mask state rides the SAME durable claim as the rows
+            # (ISSUE 12): set it on the cursor before the resumable
+            # writer's fsync-then-save inside append(), so a crash can
+            # never claim rows whose masks it forgot.  Masks observed
+            # after the last claim are re-derived by the replay.  Read
+            # from the append-only _masked_log (never the producer-
+            # mutated set), and PRUNE seats whose samples sit entirely
+            # before the claim frontier: frame f consumes samples
+            # [f·nfft, (f+ntap)·nfft), so a span ending at or before
+            # claimed_frames·nfft can never touch an un-claimed row —
+            # the persisted list stays bounded by the claim lag, not
+            # the session's degradation history.
+            if self._T is not None:
+                claimed = (self._cursor.windows_done * self._T
+                           * self._nint)
+            else:
+                claimed = self._cursor.frames_done
+            cut = claimed * self._nfft
+            log_snap = list(self._live._masked_log)
+            while (self._mask_lo < len(log_snap)
+                   and log_snap[self._mask_lo][2] <= cut):
+                self._mask_lo += 1
+            keep = {seq for seq, a, b in log_snap[self._mask_lo:]
+                    if b > cut}
+            # Premasked seats this run's feed has not re-reached yet
+            # (a second crash before them must not forget them; the
+            # _premasked set is frozen once the feed starts, so the
+            # cross-thread read is safe).
+            head = self._live._next
+            keep.update(s for s in self._live._premasked if s >= head)
+            self._cursor.masked_chunks = sorted(keep)
         self._w.append(item)
         if self._T is not None:  # ragged: one WindowHits per window
             frames = (item.window + 1) * self._T * self._nint
@@ -383,6 +453,10 @@ class _LatencyTap:
         if t is not None:
             self._tl.observe("stream.chunk_to_product_s",
                              self._clock() - t)
+        if self._hb is not None:
+            # Per-append liveness (the supervisor's lease refresh): a
+            # consumer that stops landing product rows stops beating.
+            self._hb(frames)
 
     def flush(self) -> None:
         fl = getattr(self._w, "flush", None)
@@ -408,7 +482,8 @@ def stream_reduce(source: ChunkSource, out_path: str, *,
                   reducer=None, lateness_s: Optional[float] = None,
                   stall_timeout_s: Optional[float] = None,
                   compression: Optional[str] = None,
-                  chunks=None, config: SiteConfig = DEFAULT,
+                  chunks=None, resume: bool = False, heartbeat=None,
+                  config: SiteConfig = DEFAULT,
                   **reducer_kw) -> Dict:
     """Reduce a LIVE recording to a ``.fil`` / ``.h5`` product while it
     records: the streaming twin of
@@ -418,7 +493,17 @@ def stream_reduce(source: ChunkSource, out_path: str, *,
     (``nfft``/``nint``/...) build one recording on the process-wide
     timeline (so fleet harvest and the CI telemetry artifact see the
     ``stream.*`` histograms).  Returns the product header with the
-    stream degradation report merged (``stream_masked_chunks`` et al.)."""
+    stream degradation report merged (``stream_masked_chunks`` et al.).
+
+    ``resume=True`` (ISSUE 12) makes the live consumer REJOINABLE: a
+    :class:`~blit.stream.cursor.StreamCursor` sidecar persists the
+    product claim + mask state on every durable append, and a restarted
+    consumer re-attaches to the still-recording session mid-file —
+    truncating any un-checkpointed tail, re-masking previously-masked
+    seats, and fast-forwarding through already-claimed rows via the
+    skip-frames replay — finishing byte-identical to a never-restarted
+    consumer.  ``heartbeat(frames)`` is the per-append liveness callback
+    (the :class:`blit.recover.StreamSupervisor` lease refresh)."""
     from blit.ops.channelize import STOKES_NIF
     from blit.pipeline import RawReducer
 
@@ -427,9 +512,26 @@ def stream_reduce(source: ChunkSource, out_path: str, *,
                               observability.process_timeline())
         reducer = RawReducer(**reducer_kw)
     red = reducer
+    cur = None
+    resuming = False
+    session = getattr(source, "path", "<stream>")
+    is_h5 = out_path.endswith((".h5", ".hdf5"))
+    if resume:
+        from blit.stream.cursor import StreamCursor
+
+        cur = StreamCursor.load(out_path)
+        resuming = (
+            cur is not None
+            and cur.matches(red, session, "filterbank", compression)
+            and os.path.exists(out_path)
+        )
+        if not resuming:
+            cur = StreamCursor.fresh(red, session, "filterbank",
+                                     compression)
     live = LiveRawStream(
         source, lateness_s=lateness_s, stall_timeout_s=stall_timeout_s,
         timeline=red.timeline, config=config,
+        premasked=(cur.masked_chunks if resuming else None),
     )
     # The WHOLE session publishes (ISSUE 11), not just the pump: a live
     # feed can spend minutes waiting for its first chunk, and `blit top`
@@ -438,10 +540,62 @@ def stream_reduce(source: ChunkSource, out_path: str, *,
 
     with publishing(red.timeline, config=config), \
             observability.span("stream.reduce", out=out_path,
-                               nfft=red.nfft, path=live.path):
+                               nfft=red.nfft, path=live.path,
+                               resumed=bool(resuming)):
         hdr = red.header_for(live)
         nif = STOKES_NIF[red.stokes]
-        if out_path.endswith((".h5", ".hdf5")):
+        from blit.ops.narrow import NARROW_DTYPES
+
+        if resuming:
+            # The crash guards of the batch resume path, applied before
+            # the truncate: a target the crash corrupted past reading —
+            # or one shorter than its claim — restarts fresh.
+            from blit.pipeline import resume_fil_ok
+
+            rows = cur.frames_done // red.nint
+            if is_h5:
+                from blit.io.fbh5 import resume_target_ok
+
+                ok = resume_target_ok(out_path, nif, hdr["nchans"], rows)
+            else:
+                ok = resume_fil_ok(out_path, nif, hdr["nchans"], rows,
+                                   dtype=NARROW_DTYPES[red.nbits])
+            if not ok:
+                log.warning(
+                    "stream resume target %s cannot honor the cursor's "
+                    "claimed %d frames (crash-corrupted?); restarting "
+                    "the session product fresh", out_path,
+                    cur.frames_done,
+                )
+                resuming = False
+                cur = StreamCursor.fresh(red, session, "filterbank",
+                                         compression)
+                live._premasked = set()
+        start_rows = (cur.frames_done // red.nint) if resuming else 0
+        if resume:
+            if is_h5:
+                from blit.io.fbh5 import ResumableFBH5Writer
+
+                if red.nbits != 32:
+                    raise ValueError(
+                        "nbits=8/16 quantized output is a SIGPROC .fil "
+                        "feature; FBH5 products are float32")
+                w = ResumableFBH5Writer(
+                    out_path, hdr, nif, hdr["nchans"], start_rows,
+                    red.nint, cur, compression=compression,
+                    chunks=chunks)
+            else:
+                if compression is not None:
+                    raise ValueError(".fil products are uncompressed; "
+                                     "compression applies to .h5 output")
+                if chunks is not None:
+                    raise ValueError("chunks applies to .h5 output")
+                from blit.pipeline import ResumableFilWriter
+
+                w = ResumableFilWriter(
+                    out_path, hdr, nif, hdr["nchans"], start_rows,
+                    red.nint, cur, dtype=NARROW_DTYPES[red.nbits])
+        elif is_h5:
             from blit.io.fbh5 import FBH5Writer
 
             if red.nbits != 32:
@@ -457,7 +611,6 @@ def stream_reduce(source: ChunkSource, out_path: str, *,
             if chunks is not None:
                 raise ValueError("chunks applies to .h5 output")
             from blit.io.sigproc import FilWriter
-            from blit.ops.narrow import NARROW_DTYPES
 
             # _pump delivers nbits<32 slabs already quantized narrow
             # (reduce_to_file's writer rule) — the live product must
@@ -465,8 +618,11 @@ def stream_reduce(source: ChunkSource, out_path: str, *,
             w = FilWriter(out_path, hdr, nif, hdr["nchans"],
                           dtype=NARROW_DTYPES[red.nbits])
         tap = _LatencyTap(w, live, red.timeline, nfft=red.nfft,
-                          ntap=red.ntap, nint=red.nint)
-        hdr["nsamps"] = red._pump(live, tap)
+                          ntap=red.ntap, nint=red.nint,
+                          cursor=(cur if resume else None),
+                          heartbeat=heartbeat, start_rows=start_rows)
+        hdr["nsamps"] = red._pump(live, tap,
+                                  skip_frames=start_rows * red.nint)
     # Which ingest knobs the live reduction ran (tuning profile /
     # defaults — blit/tune.py): a slow live session's report names the
     # knob source before anyone reaches for `blit tune`.
@@ -480,6 +636,7 @@ def stream_reduce(source: ChunkSource, out_path: str, *,
 def stream_search(source: ChunkSource, out_path: str, *,
                   searcher=None, lateness_s: Optional[float] = None,
                   stall_timeout_s: Optional[float] = None,
+                  resume: bool = False, heartbeat=None,
                   config: SiteConfig = DEFAULT, **search_kw) -> Dict:
     """Drift-search a LIVE recording into a ``.hits`` product while it
     records: the streaming twin of
@@ -488,8 +645,15 @@ def stream_search(source: ChunkSource, out_path: str, *,
     window ``w`` covers spectra ``[w·T, (w+1)·T)`` wherever the chunk
     boundaries fall).  ``searcher`` supplies a configured
     :class:`~blit.search.dedoppler.DedopplerReducer`; otherwise
-    ``search_kw`` build one."""
-    from blit.io.hits import HitsWriter
+    ``search_kw`` build one.
+
+    ``resume=True`` / ``heartbeat`` are the :func:`stream_reduce` rejoin
+    contract on the ragged product: the
+    :class:`~blit.stream.cursor.StreamCursor` claims whole search
+    windows (fsync-before-claim through
+    :class:`blit.io.hits.ResumableHitsWriter`), and a restarted consumer
+    rejoins at the claimed window boundary via the skip-windows replay."""
+    from blit.io.hits import HitsWriter, ResumableHitsWriter
     from blit.search import DedopplerReducer
 
     if searcher is None:
@@ -497,21 +661,45 @@ def stream_search(source: ChunkSource, out_path: str, *,
                              observability.process_timeline())
         searcher = DedopplerReducer(**search_kw)
     red = searcher
+    cur = None
+    resuming = False
+    session = getattr(source, "path", "<stream>")
+    if resume:
+        from blit.stream.cursor import StreamCursor
+
+        cur = StreamCursor.load(out_path)
+        resuming = (
+            cur is not None
+            and cur.matches(red, session, "hits")
+            and os.path.exists(out_path)
+            and os.path.getsize(out_path) >= cur.byte_offset
+        )
+        if not resuming:
+            cur = StreamCursor.fresh(red, session, "hits")
     live = LiveRawStream(
         source, lateness_s=lateness_s, stall_timeout_s=stall_timeout_s,
         timeline=red.timeline, config=config,
+        premasked=(cur.masked_chunks if resuming else None),
     )
     from blit.monitor import publishing
 
     with publishing(red.timeline, config=config), \
             observability.span("stream.search", out=out_path,
-                               nfft=red.nfft, path=live.path):
+                               nfft=red.nfft, path=live.path,
+                               resumed=bool(resuming)):
         hdr = red.header_for(live)
-        w = HitsWriter(out_path, hdr)
+        skip = cur.windows_done if resuming else 0
+        if resume:
+            w = ResumableHitsWriter(out_path, hdr, skip, cur)
+        else:
+            w = HitsWriter(out_path, hdr)
         tap = _LatencyTap(w, live, red.timeline, nfft=red.nfft,
                           ntap=red.ntap, nint=red.nint,
-                          window_spectra=red.window_spectra)
-        hdr["search_nhits"] = red._pump(live, hdr, tap)
+                          window_spectra=red.window_spectra,
+                          cursor=(cur if resume else None),
+                          heartbeat=heartbeat)
+        hdr["search_nhits"] = red._pump(live, hdr, tap,
+                                        skip_windows=skip)
     hdr["search_windows"] = tap.nwindows
     hdr["stream_tuning"] = red.tuning_provenance()
     hdr.update(live.stream_report())

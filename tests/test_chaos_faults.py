@@ -1,0 +1,68 @@
+"""The chaos fault modes (ISSUE 12): ``kill`` / ``hang`` in the
+BLIT_FAULTS grammar, with injectable kill/sleep so nothing here
+actually dies or waits."""
+
+import pytest
+
+from blit import faults
+from blit.faults import FaultRule
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.clear()
+    faults.reset_counters()
+    yield
+    faults.clear()
+    faults.reset_counters()
+
+
+class TestKillMode:
+    def test_kill_invokes_the_injectable(self):
+        hits = []
+        faults.install(FaultRule("mesh.window", "kill", after=1,
+                                 kill=lambda: hits.append(1)))
+        faults.fire("mesh.window", key="w0")  # after=1: first hit passes
+        assert hits == []
+        faults.fire("mesh.window", key="w1")
+        assert hits == [1]
+        assert faults.counters()["fault.mesh.window.kill"] == 1
+
+    def test_match_targets_one_window(self):
+        hits = []
+        faults.install(FaultRule("mesh.window", "kill", match="w3",
+                                 kill=lambda: hits.append(1)))
+        for w in range(3):
+            faults.fire("mesh.window", key=f"w{w}")
+        assert hits == []
+        faults.fire("mesh.window", key="w3")
+        assert hits == [1]
+
+
+class TestHangMode:
+    def test_hang_sleeps_hang_s_not_delay_s(self):
+        slept = []
+        faults.install(FaultRule("stream.chunk", "hang", hang_s=42.0,
+                                 sleep=slept.append))
+        faults.fire("stream.chunk", key="s#0")
+        assert slept == [42.0]
+        assert faults.counters()["fault.stream.chunk.hang"] == 1
+
+    def test_default_hang_outlasts_any_watchdog(self):
+        slept = []
+        faults.install(FaultRule("mesh.window", "hang",
+                                 sleep=slept.append))
+        faults.fire("mesh.window")
+        assert slept == [3600.0]
+
+
+class TestSpecGrammar:
+    def test_parse_kill_and_hang(self):
+        rules = faults.parse_spec(
+            "mesh.window:kill:after=2;stream.chunk:hang:hang=7.5")
+        assert rules[0].mode == "kill" and rules[0].after == 2
+        assert rules[1].mode == "hang" and rules[1].hang_s == 7.5
+
+    def test_unknown_mode_still_refused(self):
+        with pytest.raises(ValueError):
+            faults.parse_spec("mesh.window:explode")

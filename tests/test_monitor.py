@@ -734,3 +734,80 @@ class TestPlumbing:
         report = merge_fleet([telemetry_snapshot()])
         host = observability.hostname()
         assert "hist_state" in report["hosts"][host]
+
+
+class TestHonestHealthz:
+    """/healthz degrades honestly (ISSUE 12 satellite): "degraded" with
+    machine-readable reasons when breakers are not closed, a recovery
+    supervisor is mid-flight, or an SLO is in fast-burn — and the JSON
+    shape is pinned."""
+
+    _SHAPE = {"ok", "status", "reasons", "t", "host", "pid", "seq",
+              "interval_s", "watching", "breached", "alerts"}
+
+    def test_clean_process_is_ok_with_pinned_shape(self):
+        pub = MetricsPublisher(interval_s=60, spool_dir=None, port=None)
+        try:
+            h = pub.health()
+            assert self._SHAPE <= set(h)
+            assert h["status"] == "ok" and h["ok"] is True
+            assert h["reasons"] == []
+        finally:
+            pub.close()
+
+    def test_tripped_breaker_degrades(self, monkeypatch):
+        from blit.parallel import pool as pool_mod
+        from blit.parallel.pool import WorkerPool
+
+        pub = MetricsPublisher(interval_s=60, spool_dir=None, port=None)
+        wp = WorkerPool(["h0"], backend="local")
+        try:
+            br = wp.workers[0].breaker
+            for _ in range(br.threshold):
+                br.record_failure()
+            monkeypatch.setattr(pool_mod, "_current", wp)
+            h = pub.health()
+            assert h["status"] == "degraded" and h["ok"] is False
+            assert any(r.startswith("breaker-open:") for r in h["reasons"])
+            br.record_success()
+            h = pub.health()
+            assert h["status"] == "ok"
+        finally:
+            wp.shutdown()
+            pub.close()
+
+    def test_slo_fast_burn_degrades(self):
+        pub = MetricsPublisher(
+            interval_s=60, spool_dir=None, port=None,
+            objectives=[{"name": "lat", "metric": "m.s",
+                         "threshold": 0.01, "budget": 0.01}])
+        try:
+            tl = Timeline()
+            for _ in range(50):
+                tl.observe("m.s", 1.0)  # every sample is bad
+            for _ in range(6):
+                pub.slo.observe(
+                    monitor._delta_timeline(tl, None), 1.0)
+            assert pub.slo.breached() == ["lat"]
+            h = pub.health()
+            assert h["status"] == "degraded"
+            assert "slo-fast-burn:lat" in h["reasons"]
+        finally:
+            pub.close()
+
+    def test_recover_hook_degrades(self):
+        from blit.recover import _register, _unregister
+
+        pub = MetricsPublisher(interval_s=60, spool_dir=None, port=None)
+        try:
+            key = _register({"kind": "reduce", "phase": "recovering",
+                             "attempt": 2, "plan": "sharded"})
+            try:
+                h = pub.health()
+                assert h["status"] == "degraded"
+                assert any(r.startswith("recover:") for r in h["reasons"])
+            finally:
+                _unregister(key)
+            assert pub.health()["status"] == "ok"
+        finally:
+            pub.close()

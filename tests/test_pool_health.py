@@ -276,3 +276,58 @@ class TestConfigPlumbing:
             assert pool.ping_timeout is None and rw.ping_timeout is None
         finally:
             pool.shutdown()
+
+
+class TestHalfOpenProbe:
+    """The half-open probe at the POOL surface (ISSUE 12 satellite):
+    after the cooldown a tripped host admits ONE probe; success
+    re-closes, failure re-trips — and pool.health() reports half_open
+    so capacity consumers keep treating the probing host as degraded."""
+
+    def _tripped_pool(self):
+        pool = WorkerPool(["h0"], backend="local")
+        br = pool.workers[0].breaker
+        for _ in range(br.threshold):
+            br.record_failure()
+        assert pool.health()[0]["state"] == "open"
+        base = time.monotonic()
+        br.clock = lambda: base + br.cooldown_s + 1  # past the cooldown
+        return pool, br
+
+    def test_trip_half_open_close(self):
+        pool, br = self._tripped_pool()
+        assert br.allow()  # consumes THE probe slot
+        row = pool.health()[0]
+        assert row["state"] == "half-open" and row["half_open"] is True
+        assert not br.allow()  # a second caller must NOT slip through
+        br.record_success()
+        row = pool.health()[0]
+        assert row["state"] == "closed" and row["half_open"] is False
+        pool.shutdown()
+
+    def test_trip_half_open_retrip(self):
+        pool, br = self._tripped_pool()
+        assert br.allow()
+        assert pool.health()[0]["half_open"] is True
+        tripped = br.record_failure()
+        assert tripped  # ONE probe failure re-trips, not threshold more
+        row = pool.health()[0]
+        assert row["state"] == "open" and row["trips"] == 2
+        pool.shutdown()
+
+    def test_half_open_host_stays_out_of_the_budget(self):
+        # The recovered-then-flaky flap fix: only a fully CLOSED
+        # breaker restores scheduler budget — the probe phase does not.
+        from blit.serve.scheduler import Scheduler
+
+        pool, br = self._tripped_pool()
+        pool2 = WorkerPool(["h0", "h1"], backend="local")
+        pool2.workers[0].breaker = br
+        s = Scheduler(max_concurrency=2, pool=pool2)
+        assert s.effective_budget() == 1  # open: degraded
+        assert br.allow()  # half-open probe in flight
+        assert s.effective_budget() == 1  # STILL degraded — no flap
+        br.record_success()
+        assert s.effective_budget() == 2  # closed: restored
+        pool.shutdown()
+        pool2.shutdown()

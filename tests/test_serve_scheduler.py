@@ -392,3 +392,57 @@ class TestObservability:
         s.close()
         with pytest.raises(RuntimeError, match="closed"):
             s.submit(lambda: None)
+
+
+class TestCapacityHolds:
+    """Session-length capacity holds (ISSUE 12 satellite): live jobs
+    pin a concurrency slot but never poison the bounded-job EWMA or
+    the deadline estimator's work-ahead count."""
+
+    def test_hold_pins_capacity_and_reports(self):
+        tl = Timeline()
+        s = Scheduler(max_concurrency=2, timeline=tl)
+        g = Gate()
+        j = s.submit(g.job("live"), hold=True)
+        wait_for(lambda: s.held() == 1)
+        assert s.running() == 1
+        assert tl.report()["gauges"]["sched.held"]["last"] == 1.0
+        g.release.set()
+        j.result(timeout=10)
+        wait_for(lambda: s.held() == 0)
+        s.close()
+
+    def test_held_job_excluded_from_ewma(self):
+        s = Scheduler(max_concurrency=2)
+        g = Gate()
+        j = s.submit(g.job("live"), hold=True)
+        wait_for(g.started.is_set)
+        time.sleep(0.05)  # the session "runs long"
+        g.release.set()
+        j.result(timeout=10)
+        wait_for(lambda: s.held() == 0)
+        assert s._svc_ewma == 0.0, (
+            "a session's duration must not become the bounded-job "
+            "service model")
+        # A bounded job still seeds the EWMA normally.
+        s.submit(lambda: None).result(timeout=10)
+        wait_for(lambda: s.running() == 0)
+        assert s._svc_n == 1
+        s.close()
+
+    def test_deadline_admission_ignores_held_sessions(self):
+        s = Scheduler(max_concurrency=2, wait_est_floor=1 << 30)
+        # Seed a nonzero EWMA with one bounded job.
+        s.submit(lambda: time.sleep(0.05)).result(timeout=10)
+        wait_for(lambda: s.running() == 0)
+        assert s._svc_ewma > 0
+        g = Gate()
+        s.submit(g.job("live"), hold=True)
+        wait_for(lambda: s.held() == 1)
+        # Work-ahead excludes the session: a fresh tight deadline must
+        # still be admitted (the old math counted the unbounded job).
+        assert s.est_wait_s(priority=1) == 0.0
+        j = s.submit(lambda: "ok", deadline_s=0.001)
+        assert j.result(timeout=10) == "ok"
+        g.release.set()
+        s.close()

@@ -570,3 +570,123 @@ class TestScanCLI:
         for name in hits1:
             assert filecmp.cmp(str(d1 / name), str(d2 / name),
                                shallow=False), name
+
+
+class TestSearchResumeReplay:
+    def test_search_crash_resume_byte_identical(self, tmp_path,
+                                                monkeypatch):
+        # The SearchCursor twin of TestResumeReplay (ISSUE 12): crash
+        # the sharded SEARCH after the 3rd window's channelize, leave
+        # per-player cursors (window_claims ledger included), resume at
+        # the pod-agreed window, and byte-match both the uninterrupted
+        # sharded run AND the pool oracle.
+        from blit.search import DedopplerReducer
+        from blit.search.dedoppler import SearchCursor
+
+        nband, nbank = 1, 8
+        paths = make_scan(tmp_path, nband, nbank, nblocks=4)
+        wspec, wf = 4, 8
+        kw = dict(nfft=NFFT, nint=NINT, window_spectra=wspec,
+                  window_frames=wf, snr_threshold=4.0)
+        gold = tmp_path / "gold"
+        gold.mkdir()
+        gw = search_scan_sharded_to_files(paths, out_dir=str(gold), **kw)
+
+        res = tmp_path / "res"
+        res.mkdir()
+        real = M.band_reduce
+        calls = []
+
+        def flaky(*a, **k):
+            calls.append(1)
+            if len(calls) == 3:
+                raise RuntimeError("synthetic crash")
+            return real(*a, **k)
+
+        monkeypatch.setattr(M, "band_reduce", flaky)
+        with pytest.raises(RuntimeError, match="synthetic crash"):
+            search_scan_sharded_to_files(paths, out_dir=str(res),
+                                         resume=True, **kw)
+        monkeypatch.setattr(M, "band_reduce", real)
+        cursors = [p for p in os.listdir(res) if p.endswith(".cursor")]
+        assert len(cursors) == nbank, "every player keeps a cursor"
+        cur = SearchCursor.load(str(res / "band0bank0.hits"))
+        assert cur is not None and cur.window_claims is not None
+
+        rw = search_scan_sharded_to_files(paths, out_dir=str(res),
+                                          resume=True, **kw)
+        assert not [p for p in os.listdir(res) if p.endswith(".cursor")]
+        pd = tmp_path / "poolhits"
+        pd.mkdir()
+        for (b, k), (spath, shdr) in rw.items():
+            assert filecmp.cmp(spath, gw[(b, k)][0], shallow=False), (
+                f"player ({b},{k}): resumed != uninterrupted")
+            red = DedopplerReducer(nfft=NFFT, nint=NINT,
+                                   window_spectra=wspec,
+                                   snr_threshold=4.0, chunk_frames=wf)
+            opath = str(pd / f"band{b}bank{k}.hits")
+            red.search_to_file(paths[b][k], opath)
+            assert filecmp.cmp(spath, opath, shallow=False), (
+                f"player ({b},{k}): resumed != pool oracle")
+            assert shdr["search_windows"] > 0
+
+    def test_search_resume_restart_at_earlier_agreed_window(
+            self, tmp_path):
+        # The pod-minimum restart on the RAGGED product: hand-roll one
+        # player's cursor BACK two windows (as if a peer had claimed
+        # less) and check the resumed product still finishes exact —
+        # the window_claims ledger truncation.
+        from blit.search.dedoppler import SearchCursor
+
+        nband, nbank = 1, 8
+        paths = make_scan(tmp_path, nband, nbank, nblocks=4)
+        wspec, wf = 4, 8
+        kw = dict(nfft=NFFT, nint=NINT, window_spectra=wspec,
+                  window_frames=wf, snr_threshold=4.0)
+        gold = tmp_path / "gold"
+        gold.mkdir()
+        gw = search_scan_sharded_to_files(paths, out_dir=str(gold), **kw)
+
+        res = tmp_path / "res"
+        res.mkdir()
+        with pytest.raises(RuntimeError):
+            _crash_search_after(paths, res, kw, nwindows=3)
+        # Roll ONE player back: the pod-wide agreement must restart
+        # every player at the minimum.
+        target = str(res / "band0bank3.hits")
+        cur = SearchCursor.load(target)
+        assert cur.windows_done >= 2
+        back = cur.windows_done - 1
+        off, hits = cur.claim_at(back)
+        cur.windows_done, cur.byte_offset, cur.hits_done = back, off, hits
+        cur.window_claims = [e for e in cur.window_claims
+                             if e[0] <= back]
+        cur.save(target)
+        with open(target, "r+b") as f:
+            f.truncate(off)
+
+        rw = search_scan_sharded_to_files(paths, out_dir=str(res),
+                                          resume=True, **kw)
+        for (b, k), (spath, _) in rw.items():
+            assert filecmp.cmp(spath, gw[(b, k)][0], shallow=False), (
+                f"player ({b},{k}): agreed-restart resume != golden")
+
+
+def _crash_search_after(paths, outdir, kw, nwindows):
+    """Run the sharded search with a band_reduce that crashes after
+    ``nwindows`` scan windows (monkeypatch-free helper for reuse)."""
+    real = M.band_reduce
+    calls = []
+
+    def flaky(*a, **k):
+        calls.append(1)
+        if len(calls) == nwindows:
+            raise RuntimeError("synthetic crash")
+        return real(*a, **k)
+
+    M.band_reduce = flaky
+    try:
+        search_scan_sharded_to_files(paths, out_dir=str(outdir),
+                                     resume=True, **kw)
+    finally:
+        M.band_reduce = real
