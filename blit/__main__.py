@@ -51,7 +51,18 @@ Commands:
              schedule against a real supervised sharded scan or live
              stream and assert detection, degrade-and-resume (reshaped
              mesh or pool fallback / session rejoin) and product
-             byte-identity against an uninterrupted oracle.
+             byte-identity against an uninterrupted oracle.  The
+             ``--fault corrupt`` leg (ISSUE 13) instead corrupts a
+             delivered RAW frame under a digest sidecar and asserts
+             masked-not-garbage: the product must be byte-identical to
+             a zero-filled oracle with ``integrity.bad_block`` >= 1.
+  fsck       Archive integrity check (ISSUE 13): walk a tree of
+             products / disk-cache entries verifying every manifest
+             and content digest; mismatches are QUARANTINED
+             (``.quarantine/`` sibling) and exit != 0.  ``--repair``
+             re-derives quarantined cache entries from their recorded
+             recipes and retires corpses superseded by a verified
+             replacement.
   top        Live terminal dashboard (ISSUE 11): tail a monitor spool
              dir or poll a publisher endpoint during an in-progress
              reduce/scan/stream/serve — per-stage throughput, stage-tail
@@ -828,6 +839,14 @@ def _cmd_ingest_bench(args: argparse.Namespace) -> int:
                               obsnchan=args.nchan,
                               ntime_per_block=-(-ntime // args.blocks))
         file_bytes = sum(b.nbytes for b in blocks)
+        if args.digests:
+            # The integrity A/B (ISSUE 13 acceptance): every leg then
+            # ingests through per-block digest verification — the
+            # reported rates must sit inside the bench-diff noise band
+            # of an unarmed run.
+            from blit import integrity
+
+            integrity.write_raw_digests(raw_path)
         # Untimed warmup: compile the channelizer (and fault the product
         # path's buffers) so the timed legs measure steady-state
         # streaming, not the one-off jit compile.
@@ -849,7 +868,7 @@ def _cmd_ingest_bench(args: argparse.Namespace) -> int:
                 "chunk_frames": args.chunk_frames,
                 "prefetch_depth": probe.prefetch_depth,
                 "out_depth": probe.out_depth, "dtype": args.dtype,
-                "nbits": args.nbits,
+                "nbits": args.nbits, "digests": bool(args.digests),
                 "tuning": probe.tuning_provenance(),
             },
             "legs": legs,
@@ -1024,6 +1043,104 @@ def _chaos_run(sup) -> dict:
     return rep
 
 
+def _cmd_fsck(args: argparse.Namespace) -> int:
+    """``blit fsck`` (ISSUE 13): verify an archive tree's manifests and
+    cache-entry content digests, quarantine what fails, optionally
+    repair.  Exit 0 = clean tree; 1 = corruption found (the report
+    names every artifact, and everything bad is already quarantined
+    unless ``--no-quarantine``)."""
+    from blit import integrity
+
+    rep = integrity.fsck(args.root, repair=args.repair,
+                         quarantine=not args.no_quarantine)
+    body = json.dumps(rep)
+    print(body)
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            f.write(body)
+    return 0 if rep["clean"] else 1
+
+
+def _chaos_corrupt(args: argparse.Namespace, work: str,
+                   report: dict) -> int:
+    """The ``blit chaos --fault corrupt`` leg (ISSUE 13 satellite):
+    seeded in-flight corruption of one delivered RAW block under a
+    digest sidecar.  The contract asserted end to end: the mismatch is
+    DETECTED (``integrity.bad_block`` >= 1), the block is MASKED to
+    zero weight (never garbage), and the product is byte-identical to
+    an oracle reduction of the same recording with that block zeroed.
+
+    Geometry note: blocks are sized so the whole drill fits one device
+    chunk — every block then arrives as ONE delivery, so "delivery k"
+    is "block k" and the zero-filled oracle is exact."""
+    import filecmp
+    import os
+
+    import numpy as np
+
+    from blit import faults, integrity
+    from blit.io.guppi import GuppiRaw, write_raw
+    from blit.pipeline import RawReducer
+    from blit.testing import synth_raw
+
+    nblocks = max(2, args.chunks)
+    per_block = max(4, args.window_frames) * args.nfft
+    victim = min(max(0, args.after), nblocks - 1)
+    in_dir = os.path.join(work, "input")
+    oracle_dir = os.path.join(work, "oracle_input")
+    os.makedirs(in_dir, exist_ok=True)
+    os.makedirs(oracle_dir, exist_ok=True)
+    raw = os.path.join(in_dir, "chaos.raw")
+    synth_raw(raw, nblocks=nblocks, obsnchan=args.nchan,
+              ntime_per_block=per_block, seed=args.seed)
+    # The zero-filled oracle: the SAME recording with the victim block
+    # zeroed (same basename so derived headers cannot differ).
+    rdr0 = GuppiRaw(raw, native=False)
+    blocks = [np.array(rdr0.read_block(i)) for i in range(nblocks)]
+    blocks[victim][:] = 0
+    write_raw(os.path.join(oracle_dir, "chaos.raw"),
+              dict(rdr0.header(0)), blocks)
+    integrity.write_raw_digests(raw)
+    # One chunk spans the whole recording: leave the (ntap-1)-frame PFB
+    # tail after chunk_frames so every block lands as one delivery.
+    cf = max(args.nint, (nblocks * per_block) // args.nfft - 3)
+    kw = dict(nfft=args.nfft, nint=args.nint, chunk_frames=cf,
+              tune_online=False)
+    oracle = os.path.join(work, "oracle.fil")
+    RawReducer(**kw).reduce_to_file(
+        os.path.join(oracle_dir, "chaos.raw"), oracle)
+    out = os.path.join(work, "chaos.fil")
+    faults.reset_counters()
+    faults.install(faults.FaultRule(point="guppi.read", mode="corrupt",
+                                    after=victim, times=1))
+    try:
+        rdr = GuppiRaw(raw)  # arms the digest sidecar
+        hdr = RawReducer(**kw).reduce_to_file(rdr, out)
+    finally:
+        faults.clear()
+    counters = faults.counters()
+    try:
+        identical = filecmp.cmp(out, oracle, shallow=False)
+    except OSError:
+        identical = False
+    bad_blocks = int(counters.get("integrity.bad_block", 0))
+    report.update(
+        recovered=bad_blocks >= 1,
+        byte_identical=identical,
+        victim_block=victim,
+        masked_blocks=hdr.get("_masked_blocks", []),
+        integrity={k: v for k, v in sorted(counters.items())
+                   if k.startswith(("integrity.", "mask."))},
+        work_dir=work,
+    )
+    body = json.dumps(report)
+    print(body)
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            f.write(body)
+    return 0 if (identical and bad_blocks >= 1) else 1
+
+
 def _cmd_chaos(args: argparse.Namespace) -> int:
     """``blit chaos`` (ISSUE 12): run a SEEDED kill/hang schedule
     against a real supervised workload — a multi-process sharded scan
@@ -1046,6 +1163,13 @@ def _cmd_chaos(args: argparse.Namespace) -> int:
     os.makedirs(work, exist_ok=True)
     point = args.point or ("stream.chunk" if args.workload == "stream"
                            else "mesh.window")
+    if args.fault == "corrupt":
+        # The integrity leg (ISSUE 13) is its own drill shape: no
+        # supervisor, no crash — a corrupted delivered frame must be
+        # detected and MASKED, whatever the workload flag says.
+        report = {"workload": "reduce",
+                  "fault": f"guppi.read:corrupt:after={args.after}"}
+        return _chaos_corrupt(args, work, report)
     fault = (f"{point}:{args.fault}:after={args.after}"
              + (f":hang={args.hang_s}" if args.fault == "hang" else ""))
     report = {"workload": args.workload, "fault": fault,
@@ -1614,6 +1738,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "to the sync path's host quantization)")
     pg.add_argument("--quant-scale", type=float, default=1.0,
                     help="affine quantize scale for --nbits 8/16")
+    pg.add_argument("--digests", action="store_true",
+                    help="arm a per-block digest sidecar on the "
+                         "synthetic recording so every leg ingests "
+                         "through integrity verification (ISSUE 13; "
+                         "rates must stay inside the bench-diff noise "
+                         "band of an unarmed run)")
     pg.add_argument("--sync-compare", action="store_true",
                     help="also run the fully synchronous output path and "
                          "report the async speedup")
@@ -1721,8 +1851,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                     choices=["scan", "scan-search", "stream"],
                     help="what to break: a supervised sharded scan, a "
                          "supervised sharded search, or a live consumer")
-    pc.add_argument("--fault", default="kill", choices=["kill", "hang"],
-                    help="the injected failure mode")
+    pc.add_argument("--fault", default="kill",
+                    choices=["kill", "hang", "corrupt"],
+                    help="the injected failure mode (corrupt = the "
+                         "ISSUE 13 integrity leg: a bit-flipped "
+                         "delivered RAW frame under a digest sidecar "
+                         "must be masked, not propagated)")
     pc.add_argument("--after", type=int, default=2,
                     help="fire after this many windows/chunks")
     pc.add_argument("--hang-s", type=float, default=60.0,
@@ -1766,6 +1900,28 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="also write the drill report JSON here "
                          "(the CI chaos-smoke artifact)")
     pc.set_defaults(fn=_cmd_chaos)
+
+    pk = sub.add_parser(
+        "fsck",
+        help="verify an archive tree (manifests + cache content "
+             "digests), quarantining corruption; exit 1 when any is "
+             "found (ISSUE 13)",
+    )
+    pk.add_argument("root", help="tree to walk: product dirs and/or a "
+                                 "serve disk-cache dir")
+    pk.add_argument("--repair", action="store_true",
+                    help="re-derive quarantined cache entries from "
+                         "their recorded recipes (the serve layer's "
+                         "miss path) and retire quarantined corpses "
+                         "superseded by a verified replacement")
+    pk.add_argument("--no-quarantine", action="store_true",
+                    help="report only; leave corrupt artifacts in "
+                         "place (default: move them to a .quarantine/ "
+                         "sibling so they stop being served/resumed)")
+    pk.add_argument("--json-out", default=None,
+                    help="also write the fsck report JSON here "
+                         "(the CI drill artifact)")
+    pk.set_defaults(fn=_cmd_fsck)
 
     pt = sub.add_parser(
         "telemetry",

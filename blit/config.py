@@ -209,6 +209,19 @@ class SiteConfig:
     recover_poll_s: float = 0.2
     recover_max_attempts: int = 3
     recover_grace_s: float = 120.0
+    # Data-integrity plane (blit/integrity.py; ISSUE 13).  The
+    # background scrubber is OFF unless scrub_interval_s is set —
+    # verification between requests must be a deliberate choice; when
+    # on, it verifies one disk-tier entry per interval and paces itself
+    # so verified bytes/s stays under scrub_bytes_per_s (big entries
+    # buy longer pauses — scrubbing samples the archive, it never
+    # competes with a request burst).  Per-process overrides:
+    # BLIT_SCRUB_INTERVAL / BLIT_SCRUB_BYTES_PER_S
+    # (:func:`scrub_defaults`); BLIT_VERIFY_INGEST=0 /
+    # BLIT_VERIFY_CACHE=0 are the verification escape hatches
+    # (blit.integrity.ingest_verify_enabled / cache_verify_enabled).
+    scrub_interval_s: Optional[float] = None
+    scrub_bytes_per_s: float = 64e6
 
     def io_retry_policy(self):
         """The :class:`blit.faults.RetryPolicy` for worker-side file I/O —
@@ -415,6 +428,29 @@ def recover_defaults(config: SiteConfig = DEFAULT) -> Dict:
             "BLIT_RECOVER_MAX_ATTEMPTS", config.recover_max_attempts)),
         "grace_s": float(os.environ.get(
             "BLIT_RECOVER_GRACE", config.recover_grace_s)),
+    }
+
+
+def scrub_defaults(config: SiteConfig = DEFAULT) -> Dict:
+    """The effective integrity-scrubber knob set (ISSUE 13): ``config``'s
+    values with per-process ``BLIT_SCRUB_*`` environment overrides
+    applied — the :func:`stream_defaults` pattern, resolved at service
+    construction so drills and deployments retune per run.  ``enabled``
+    is derived: scrubbing is on only when an interval is configured."""
+    v = os.environ.get("BLIT_SCRUB_INTERVAL")
+    if v is None:
+        interval = config.scrub_interval_s
+    elif not v or v.lower() == "none" or float(v) <= 0:
+        # "", "none", 0 and negatives all DISABLE (the health_port=0
+        # convention) — 0 must never mean a busy verification loop.
+        interval = None
+    else:
+        interval = float(v)
+    return {
+        "interval_s": interval,
+        "bytes_per_s": float(os.environ.get(
+            "BLIT_SCRUB_BYTES_PER_S", config.scrub_bytes_per_s)),
+        "enabled": interval is not None,
     }
 
 

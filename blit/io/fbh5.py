@@ -345,6 +345,13 @@ class _ChunkStream:
         faults.retry_io(_write, describe=f"fbh5 chunk write {self.path}")
         self.nsamps += rows
         self._buffered = 0
+        # Manifest fold at CLAIM granularity (ISSUE 13): only rows
+        # flushed as full chunks are ever claimed by a cursor, so the
+        # digest ledger advances exactly with them.
+        mf = getattr(self, "_mf", None)
+        if mf is not None:
+            mf.fold(np.ascontiguousarray(self._buf[:rows]))
+            mf.claim(self.nsamps)
 
     def _buffer_slab(self, slab: np.ndarray) -> bool:
         """Buffer ``slab``'s rows, flushing every completed chunk; returns
@@ -429,6 +436,16 @@ class FBH5Writer(_ChunkStream):
             os.unlink(self.path)
             raise
         self.nsamps = 0  # spectra durably in the dataset
+        # Product manifest (ISSUE 13): logical-row digests folded as
+        # slabs append; the whole-file CRC is computed by one re-read at
+        # close (libhdf5 metadata churn makes mid-stream file-byte CRCs
+        # meaningless — the fbh5 manifest digests the DATA rows).
+        from blit import integrity
+
+        self._mf = integrity.ManifestWriter(
+            self.final_path, "fbh5",
+            row_bytes=nifs * nchans * self.dtype.itemsize,
+            writer=type(self).__name__)
         # Pending partial chunk row (the bitshuffle path buffers up to one;
         # the plain/gzip paths let libhdf5 chunk and never touch this).
         self._buf = (
@@ -453,6 +470,9 @@ class FBH5Writer(_ChunkStream):
                 self._ds[self.nsamps:] = slab
             faults.retry_io(_write, describe=f"fbh5 write {self.path}")
             self.nsamps += k
+            # Digest the STORED dtype bytes (h5py casts on assignment).
+            self._mf.fold(np.ascontiguousarray(slab, self.dtype))
+            self._mf.claim(self.nsamps)
             return
         self._buffer_slab(slab)
 
@@ -480,6 +500,10 @@ class FBH5Writer(_ChunkStream):
         except BaseException:
             self.abort()
             raise
+        # Whole-file digest over the finished bytes (one re-read,
+        # page-cache hot); best-effort — a manifest failure must never
+        # un-publish the product.
+        self._mf.publish(scan_file=True)
 
     def abort(self) -> None:
         """Drop the partial product (crash/exception path)."""
@@ -611,16 +635,46 @@ class ResumableFBH5Writer(_ChunkStream):
                 os.unlink(path)
                 raise
         self.nsamps = start_rows
+        # Product manifest (ISSUE 13): the claim ledger checkpoints
+        # beside the cursor, so a resume can content-verify the claimed
+        # rows (resume_target_ok) before trusting it.  On resume the
+        # running digest is rebuilt over the truncated claim (callers
+        # already verified it matches the ledger).
+        from blit import integrity
+
+        self._mf = integrity.ManifestWriter(
+            path, "fbh5", row_bytes=nifs * nchans * self.dtype.itemsize,
+            writer=type(self).__name__)
+        if start_rows > 0:
+            row_bytes = nifs * nchans * self.dtype.itemsize
+            step = max(1, (8 << 20) // max(1, row_bytes))
+            manual = _needs_manual_bitshuffle(self._ds)
+            for a in range(0, start_rows, step):
+                b = min(start_rows, a + step)
+                slab = (
+                    _read_bitshuffle_chunks(
+                        self._ds, ((a, b), (0, nifs), (0, nchans)))
+                    if manual else self._ds[a:b]
+                )
+                self._mf.fold(np.ascontiguousarray(slab, self.dtype))
+            self._mf.claim(start_rows)
+        self._mf.save()
         self._buf = (
             np.empty(self.chunks, self.dtype) if self._bitshuffle else None
         )
         self._buffered = 0
 
     def _checkpoint(self, rows: int) -> None:
-        """Durable data BEFORE the cursor claims it (power-loss ordering):
-        flush libhdf5 buffers, fsync the file, then persist the cursor."""
+        """Durable data BEFORE the cursor claims it (power-loss
+        ordering): flush libhdf5 buffers, fsync the file, persist the
+        MANIFEST (its ledger must always hold an entry for every row
+        count a cursor can claim — ahead is harmless, behind is an
+        unverifiable gap), then the cursor."""
         self._h5.flush()
         os.fsync(self._h5.id.get_vfd_handle())
+        mf = getattr(self, "_mf", None)
+        if mf is not None:  # absent only during __init__'s own call
+            mf.save()
         self.cursor.frames_done = rows * self._nint
         self.cursor.save(self.path)
 
@@ -641,9 +695,12 @@ class ResumableFBH5Writer(_ChunkStream):
                 self._ds[self.nsamps:] = slab
             faults.retry_io(_write, describe=f"fbh5 write {self.path}")
             self.nsamps += k
-            self._checkpoint(self.nsamps)
+            self._mf.fold(np.ascontiguousarray(slab, self.dtype))
+            self._mf.claim(self.nsamps)
+            self._checkpoint(self.nsamps)  # saves manifest, then cursor
             return
         if self._buffer_slab(slab):
+            # _flush_chunk already folded + claimed the flushed rows.
             self._checkpoint(self.nsamps)
 
     def close(self) -> None:
@@ -658,6 +715,9 @@ class ResumableFBH5Writer(_ChunkStream):
         os.fsync(self._h5.id.get_vfd_handle())
         self._h5.close()
         self._h5 = None
+        # Completed product: whole-file digest (the manifest stays; the
+        # cursor sidecar below goes — its absence marks completeness).
+        self._mf.publish(scan_file=True)
         # The cursor names its own sidecar when it can (StreamCursor's
         # ``.stream-cursor`` sibling, blit/stream/cursor.py); the duck-
         # typed fallback keeps the ReductionCursor ``.cursor`` default.
@@ -700,6 +760,13 @@ def resume_target_ok(path: str, nifs: int, nchans: int, rows: int) -> bool:
     claim, and decodes the last claimed row (one chunk read — under
     bitshuffle the cursor only ever claims flushed chunks, so that row
     must decode).  Any failure anywhere is a ``False``, not an error.
+
+    When a manifest sidecar exists the structural probe is UPGRADED to
+    content verification (ISSUE 13): the claimed rows' digest must match
+    the manifest's claim ledger — bit rot or a torn write *inside* the
+    claimed region fails closed where the decode probe alone would have
+    resumed onto (structurally valid) corrupt spectra.  No manifest
+    keeps the structural behavior.
     """
     try:
         with h5py.File(path, "r") as h5:
@@ -710,9 +777,11 @@ def resume_target_ok(path: str, nifs: int, nchans: int, rows: int) -> bool:
             read_fbh5_data(
                 path, (slice(rows - 1, rows), slice(None), slice(None))
             )
-        return True
     except Exception:  # noqa: BLE001 — any unreadability means start fresh
         return False
+    from blit import integrity
+
+    return integrity.verify_claim(path, rows, fmt="fbh5") is not False
 
 
 def write_fbh5(

@@ -21,6 +21,8 @@ import glob
 import logging
 import os
 import re
+import time
+import zlib
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
@@ -200,6 +202,27 @@ class GuppiRaw(_BlockStream):
         self.headers, self._data_offsets = faults.retry_io(
             _scan, describe=f"guppi open {path}"
         )
+        # Ingest verification (ISSUE 13): when a per-member digest
+        # sidecar exists (<path>.digests.json, blit/integrity.py) every
+        # delivered block is verified — the on-disk payload against the
+        # sidecar at first touch (bit rot), the delivered frame against
+        # the on-disk bytes per delivery (an in-flight flip, the seeded
+        # ``corrupt`` fault mode's shape) — and a mismatched block is
+        # ZERO-FILLED (the PR 2/7 zero-weight mask discipline applied to
+        # blocks: it contributes nothing downstream) instead of
+        # propagating garbage.  bad_blocks is the per-reader mask set the
+        # reducer surfaces into the product header (_masked_blocks).
+        self.bad_blocks: set = set()
+        self._block_digests: Optional[List[int]] = None
+        self._digest_ok_memo: Dict[int, bool] = {}
+        self._integrity_dumped = False
+        self._verify_map: Optional[np.ndarray] = None  # lazy flat mmap
+        from blit import integrity
+
+        if integrity.ingest_verify_enabled():
+            # Raises IntegrityError on a sidecar that exists but does
+            # not parse — never reduce against an untrustworthy sidecar.
+            self._block_digests = integrity.load_raw_digests(path)
 
     @property
     def nblocks(self) -> int:
@@ -216,6 +239,127 @@ class GuppiRaw(_BlockStream):
             raise NotImplementedError(f"NBITS={nbits} not supported (GBT uses 8)")
         npol = 2 if hdr["NPOL"] > 2 else hdr["NPOL"]
         return hdr["OBSNCHAN"], block_ntime(hdr), npol
+
+    # -- ingest verification (ISSUE 13) ---------------------------------
+    def _mark_bad(self, i: int, why: str) -> None:
+        """Record block ``i`` as failed verification: counter + flight
+        dump (forced once per reader — the incident trail must exist)
+        + the mask set the reducer mirrors into the product header."""
+        if i in self.bad_blocks:
+            return
+        self.bad_blocks.add(i)
+        self._digest_ok_memo[i] = False
+        faults.incr("integrity.bad_block")
+        log.error(
+            "%s block %d %s; masking it to zero weight and continuing "
+            "degraded", self.path, i, why,
+        )
+        try:
+            from blit.observability import flight_recorder
+
+            rec = flight_recorder()
+            rec.event("integrity", "bad_block", path=self.path, block=i,
+                      why=why)
+            rec.dump(
+                f"integrity: {self.path} block {i} {why}; delivered "
+                "zero-filled (masked) instead of propagating garbage",
+                force=not self._integrity_dumped,
+            )
+            self._integrity_dumped = True
+        except Exception:  # noqa: BLE001 — telemetry must not fail reads
+            pass
+
+    def _digest_ok(self, i: int) -> bool:
+        """Memoized on-disk check of block ``i``: CRC of the payload
+        bytes on disk against the sidecar (bit rot / a flipped byte on
+        the archive).  Runs once per block, on the reading thread, from
+        pages the read itself just pulled hot."""
+        ok = self._digest_ok_memo.get(i)
+        if ok is not None:
+            return ok
+        from blit import integrity
+
+        digests = self._block_digests
+        if digests is None or i >= len(digests):
+            # Sidecar shorter than the recording (it grew since the
+            # digests were taken): the extra blocks are unverifiable,
+            # not bad — deliver them unchecked, as without a sidecar.
+            self._digest_ok_memo[i] = True
+            return True
+        t0 = time.perf_counter()
+        off = self._data_offsets[i]
+        mm = self._vmap()
+        crc = zlib.crc32(
+            mm[off:off + int(self.headers[i]["BLOCSIZE"])]) & 0xFFFFFFFF
+        integrity.observe_verify(time.perf_counter() - t0)
+        ok = crc == digests[i]
+        if not ok:
+            self._mark_bad(i, "failed its on-disk digest "
+                               f"({integrity.hex_crc(crc)} != "
+                               f"{integrity.hex_crc(digests[i])})")
+        self._digest_ok_memo[i] = ok
+        return ok
+
+    def _vmap(self) -> np.ndarray:
+        """The verification view: ONE flat byte memmap over the whole
+        file, built lazily and reused across deliveries (a per-delivery
+        mmap would dominate verification cost on small blocks)."""
+        if self._verify_map is None:
+            self._verify_map = np.memmap(self.path, dtype=np.uint8,
+                                         mode="r")
+        return self._verify_map
+
+    def _delivery_ok(self, i: int, dst: np.ndarray, t0: int,
+                     nt: int) -> bool:
+        """Per-delivery check: the DELIVERED region against the same
+        region on disk (catches an in-flight flip — the seeded
+        ``corrupt`` fault mode — after the disk itself verified).
+        memcmp, not a digest: the disk already verified against the
+        sidecar, so equality IS correctness here, and a vectorized
+        compare costs a fraction of a second CRC pass."""
+        nchan, ntime, npol = self._block_geometry(i)
+        samp = npol * 2
+        row = ntime * samp
+        base = self._data_offsets[i] + t0 * samp
+        mm = self._vmap()
+        t_start = time.perf_counter()
+        try:
+            for c in range(nchan):
+                off = base + c * row
+                got = np.ascontiguousarray(
+                    dst[c, :nt]).view(np.uint8).reshape(-1)
+                if not np.array_equal(got, mm[off:off + nt * samp]):
+                    self._mark_bad(
+                        i, "delivered a frame that does not match the "
+                           "bytes on disk (in-flight corruption)")
+                    return False
+            return True
+        finally:
+            from blit import integrity
+
+            integrity.observe_verify(time.perf_counter() - t_start)
+
+    def _verify_delivery(self, i: int, dst: np.ndarray, t0: int,
+                         nt: int) -> None:
+        """The one masking rule both read paths share: a block that is
+        already bad, fails its on-disk digest, or delivered bytes that
+        do not match disk is ZERO-FILLED in place.
+
+        Masking granularity when a block spans several deliveries:
+        ON-DISK rot is detected at the block's FIRST delivery (the
+        sidecar check runs before any of its bytes emit), so the whole
+        block is zeroed exactly — the zero-filled-oracle identity.  An
+        IN-FLIGHT flip is detected at the corrupted delivery; that
+        delivery and every later one of the block are zeroed, while
+        earlier deliveries already passed the delivered-vs-disk check
+        against sidecar-verified disk bytes — they carried CORRECT
+        data, never garbage.  ``bad_blocks`` / ``_masked_blocks``
+        therefore mean "block contains zero-masked samples"."""
+        bad = i in self.bad_blocks or not self._digest_ok(i)
+        if not bad and not self._delivery_ok(i, dst, t0, nt):
+            bad = True
+        if bad:
+            dst[:, :nt] = 0
 
     def read_block(self, i: int) -> np.ndarray:
         """Raw int8 voltages of block ``i``, shaped
@@ -249,6 +393,37 @@ class GuppiRaw(_BlockStream):
                 elif act.mode == "corrupt":
                     arr = np.array(arr)  # memmaps are read-only views
                     arr[0] ^= 0x55
+            if self._block_digests is not None and arr.shape[1] == ntime:
+                # Digest-armed whole-block delivery: verify against the
+                # sidecar/disk and deliver zeros on mismatch (masked).
+                bad = i in self.bad_blocks or not self._digest_ok(i)
+                if (not bad and i < len(self._block_digests)
+                        and (self.native or act is not None)):
+                    # Only a COPIED frame (native pread buffer, or a
+                    # drilled act) can diverge from the disk bytes
+                    # _digest_ok just verified — the untouched memmap
+                    # view IS those bytes, a second pass proves
+                    # nothing.  memcmp, not a digest (the
+                    # _delivery_ok rule): the disk already verified,
+                    # so equality IS correctness.
+                    from blit import integrity
+
+                    off = self._data_offsets[i]
+                    t_start = time.perf_counter()
+                    same = np.array_equal(
+                        np.ascontiguousarray(arr).view(
+                            np.uint8).reshape(-1),
+                        self._vmap()[off:off + arr.nbytes])
+                    integrity.observe_verify(
+                        time.perf_counter() - t_start)
+                    if not same:
+                        self._mark_bad(
+                            i, "delivered a frame that does not match "
+                               "the bytes on disk (in-flight "
+                               "corruption)")
+                        bad = True
+                if bad:
+                    arr = np.zeros(shape, np.int8)
             return arr
 
         return faults.retry_io(_read, describe=f"guppi read {self.path}")
@@ -329,6 +504,11 @@ class GuppiRaw(_BlockStream):
                     dst[:, :nt] = mm[:, t0 : t0 + nt]
                 if act is not None and act.mode == "corrupt":
                     dst[0, :nt] ^= 0x55
+                if self._block_digests is not None:
+                    # Digest-armed delivery (ISSUE 13): a block that
+                    # fails verification is delivered ZERO-FILLED — the
+                    # zero-weight mask, not garbage.
+                    self._verify_delivery(i, dst, t0, nt)
             return nt
 
         return faults.retry_io(_read, describe=f"guppi read {self.path}")
@@ -365,11 +545,13 @@ class GuppiRaw(_BlockStream):
                 done += got
 
     def close(self) -> None:
-        """Release the persistent pread descriptor (idempotent; the
-        reader stays usable — the fd reopens on demand)."""
+        """Release the persistent pread descriptor and the verification
+        memmap (idempotent; the reader stays usable — both reopen on
+        demand)."""
         fd, self._pread_fd = self._pread_fd, None
         if fd is not None:
             os.close(fd)
+        self._verify_map = None
 
     def __del__(self):  # best-effort: interpreter teardown tolerant
         try:
@@ -533,6 +715,15 @@ class GuppiScan(_BlockStream):
     def read_block_complex(self, i: int) -> np.ndarray:
         fi, bi = self._blocks[i]
         return self.files[fi].read_block_complex(bi)
+
+    @property
+    def bad_blocks(self) -> set:
+        """Digest-failed (masked) blocks as GLOBAL stream indices —
+        the union of every member's per-file mask set (ISSUE 13)."""
+        return {
+            g for g, (fi, bi) in enumerate(self._blocks)
+            if bi in self.files[fi].bad_blocks
+        }
 
 
 RawSource = Union[str, Sequence[str], GuppiRaw, GuppiScan]

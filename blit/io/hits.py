@@ -107,10 +107,19 @@ class HitsWriter:
     writer-contract name every sink already speaks."""
 
     def __init__(self, path: str, header: Dict) -> None:
+        from blit import integrity
+
         self.path = path
         self._tmp = path + ".partial"
         self._f = open(self._tmp, "w")
-        self._f.write(header_line(header))
+        hl = header_line(header)
+        self._f.write(hl)
+        # Product manifest (ISSUE 13): the running CRC folds every byte
+        # in write order, so the completed running CRC IS the whole-file
+        # digest; published as <product>.manifest.json at close.
+        self._mf = integrity.ManifestWriter(
+            path, "hits", writer=type(self).__name__)
+        self._mf.fold(hl.encode())
         self.nsamps = 0
         self.nwindows = 0
 
@@ -118,6 +127,8 @@ class HitsWriter:
         self._f.write(wh.lines)
         self.nsamps += len(wh.hits)
         self.nwindows += 1
+        self._mf.fold(wh.lines.encode())
+        self._mf.claim(self.nwindows)
 
     def flush(self) -> None:
         self._f.flush()
@@ -127,6 +138,7 @@ class HitsWriter:
         self.flush()
         self._f.close()
         os.replace(self._tmp, self.path)
+        self._mf.publish()
 
     def abort(self) -> None:
         """Error-path teardown: drop the ``.partial`` (never leave a
@@ -150,8 +162,12 @@ class ResumableHitsWriter:
 
     def __init__(self, path: str, header: Dict, start_windows: int,
                  cursor) -> None:
+        from blit import integrity
+
         self.path = path
         self.cursor = cursor
+        self._mf = integrity.ManifestWriter(
+            path, "hits", writer=type(self).__name__)
         if start_windows > 0 and os.path.exists(path):
             # The restart may sit EARLIER than this cursor's own claim
             # (the sharded plane restarts at the pod-wide-agreed minimum,
@@ -187,6 +203,12 @@ class ResumableHitsWriter:
                     if e[0] <= start_windows
                 ]
             cursor.save(path)
+            # Rebuild the running digest over the truncated claim
+            # (callers content-verified it via verify_hits_claim) and
+            # checkpoint the manifest ledger at the restart point.
+            self._mf.fold_path(path)
+            self._mf.claim(start_windows)
+            self._mf.save()
             self._f = open(path, "a")
         else:
             self._f = open(path, "w")
@@ -199,6 +221,8 @@ class ResumableHitsWriter:
             if hasattr(cursor, "window_claims"):
                 cursor.window_claims = []
             cursor.save(path)
+            self._mf.fold_path(path)
+            self._mf.save()
         # Cumulative across the whole product, resumed windows included
         # (the ResumableFilWriter nsamps = start_rows convention) — the
         # finished header's search_nhits must count every hit line in
@@ -214,6 +238,13 @@ class ResumableHitsWriter:
         os.fsync(self._f.fileno())
         self.nsamps += len(wh.hits)
         self.nwindows += 1
+        # Manifest BETWEEN the fsync and the cursor claim (ISSUE 13,
+        # the ResumableFilWriter ordering): the ledger then always
+        # holds an entry for every window count a cursor can claim —
+        # a crash leaves the manifest AHEAD (harmless), never behind.
+        self._mf.fold(wh.lines.encode())
+        self._mf.claim(self.nwindows)
+        self._mf.save()
         self.cursor.windows_done = self.nwindows
         self.cursor.hits_done = self.nsamps
         self.cursor.byte_offset = self._f.tell()
@@ -229,8 +260,10 @@ class ResumableHitsWriter:
         os.fsync(self._f.fileno())
 
     def close(self) -> None:
-        """Finish: the sidecar's absence is the completeness marker."""
+        """Finish: the sidecar's absence is the completeness marker; the
+        manifest flips to complete and stays (the fsck surface)."""
         self._f.close()
+        self._mf.publish()
         sidecar = self.cursor.path_for(self.path)
         if os.path.exists(sidecar):
             os.unlink(sidecar)

@@ -113,6 +113,23 @@ def read_fil_data(
     nbits = header.get("nbits", 32)
     if nbits not in _DTYPES:
         raise ValueError(f"{path}: unsupported nbits={nbits}")
+    # Header-vs-payload cross-check (ISSUE 13 satellite, closing the
+    # gap the validate_slab docstring documents): SIGPROC derives nsamps
+    # from file size, so a payload that is not a whole number of
+    # (nifs, nchans) spectra means the header lies about the layout
+    # (torn write, wrong nchans/nbits, foreign bytes) — REFUSE with a
+    # clear error instead of returning a silently mis-shaped array.
+    nifs = header.get("nifs", 1)
+    nchans = header["nchans"]
+    sample_bytes = nchans * nifs * nbits // 8
+    payload = os.path.getsize(path) - offset
+    if sample_bytes <= 0 or payload % sample_bytes:
+        raise ValueError(
+            f"{path}: payload of {payload} bytes is not a whole number "
+            f"of (nifs={nifs}, nchans={nchans}, nbits={nbits}) spectra "
+            f"of {sample_bytes} bytes — truncated or corrupt product "
+            "(header disagrees with the bytes on disk)"
+        )
     shape = (header["nsamps"], header.get("nifs", 1), header["nchans"])
     if mmap:
         data = np.memmap(path, dtype=_DTYPES[nbits], mode="r", offset=offset, shape=shape)
@@ -157,6 +174,8 @@ class FilWriter:
                  dtype=np.float32):
         import os as _os
 
+        from blit import integrity
+
         self.final_path = path
         self.path = path + ".partial"
         self._os = _os
@@ -164,6 +183,17 @@ class FilWriter:
         self.nchans = nchans
         self.dtype = np.dtype(dtype)
         write_fil(self.path, header, np.zeros((0, nifs, nchans), dtype))
+        # Product manifest (ISSUE 13): per-window digests + whole-file
+        # CRC, folded as slabs append (this runs on the write-behind
+        # sink thread under the async plane — digesting rides the
+        # thread that already owns the bytes) and published as a
+        # <product>.manifest.json sidecar at close.
+        self._mf = integrity.ManifestWriter(
+            self.final_path, "fil",
+            row_bytes=nifs * nchans * self.dtype.itemsize,
+            writer=type(self).__name__)
+        self._mf.data_offset = _os.path.getsize(self.path)
+        self._mf.fold_path(self.path)
         self._f = open(self.path, "ab")
         self.nsamps = 0
 
@@ -173,6 +203,8 @@ class FilWriter:
         slab = validate_slab(slab, self.nifs, self.nchans, self.dtype)
         slab.tofile(self._f)
         self.nsamps += slab.shape[0]
+        self._mf.fold(slab)
+        self._mf.claim(self.nsamps)
 
     def flush(self) -> None:
         """Push appended bytes to the OS — the write-behind sink's flush
@@ -192,6 +224,9 @@ class FilWriter:
         except BaseException:
             self.abort()
             raise
+        # After the atomic publish: the manifest sidecar (best-effort —
+        # a manifest-write failure must never un-publish the product).
+        self._mf.publish()
 
     def abort(self) -> None:
         """Drop the partial product (crash/exception path)."""

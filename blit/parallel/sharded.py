@@ -630,6 +630,16 @@ def search_scan_sharded_to_files(
                 and os.path.exists(path)
                 and os.path.getsize(path) >= cur.byte_offset
             )
+            if ok:
+                # Content verification of the claim (ISSUE 13): a flip
+                # INSIDE the claimed lines or a tampered sidecar fails
+                # closed to a fresh start — the byte-length probe above
+                # cannot see either, and the resumed writer would bake
+                # the corruption into a fresh manifest.
+                from blit import integrity
+
+                ok = integrity.verify_claim(path, cur.windows_done,
+                                            fmt="hits") is not False
             if not ok:
                 size, mtime_ns = ReductionCursor.stat_raw(paths_bk)
                 cur = SearchCursor(

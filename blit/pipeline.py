@@ -903,6 +903,23 @@ class RawReducer:
             self._retire_staging()
             return total
 
+    def _surface_integrity(self, raw, hdr: Dict) -> None:
+        """Mirror digest-failed (zero-masked) blocks into the product
+        header through the ONE mask bookkeeping rule (ISSUE 13: the
+        PR 2/7 ``record_mask`` discipline, kind="block") — a degraded
+        product says so everywhere a healthy one reports
+        (``_masked_blocks``, the ``block.masked`` timeline counter, the
+        process-wide ``mask.block`` fault counter)."""
+        bad = sorted(getattr(raw, "bad_blocks", None) or ())
+        if not bad:
+            return
+        from blit.parallel.antenna import record_mask
+
+        masked: set = set()
+        for b in bad:
+            record_mask(masked, b, "failed digest verification",
+                        header=hdr, timeline=self.timeline, kind="block")
+
     # -- whole-file conveniences ------------------------------------------
     def _open_validated(self, raw_src: RawSource):
         """Shared prologue of every whole-recording entry point: open the
@@ -951,6 +968,7 @@ class RawReducer:
         # say so or a later write_fil of (hdr, data) lies about the dtype.
         hdr["nbits"] = self.nbits
         hdr["nsamps"] = data.shape[0]
+        self._surface_integrity(raw, hdr)
         return hdr, data
 
     def reduce_to_file(self, raw_src: RawSource, out_path: str,
@@ -983,6 +1001,7 @@ class RawReducer:
             )
             with observability.span("reduce.to_file", out=out_path):
                 hdr["nsamps"] = self._pump(raw, w)
+            self._surface_integrity(raw, hdr)
             return hdr
         if compression is not None:
             raise ValueError(".fil products are uncompressed; compression "
@@ -1005,6 +1024,7 @@ class RawReducer:
                       dtype=NARROW_DTYPES[self.nbits])
         with observability.span("reduce.to_file", out=out_path):
             hdr["nsamps"] = self._pump(raw, w)
+        self._surface_integrity(raw, hdr)
         return hdr
 
     def reduce_resumable(self, raw_src: RawSource, out_path: str,
@@ -1126,6 +1146,7 @@ class RawReducer:
                                 resumed=bool(resuming)):
             hdr["nsamps"] = self._pump(raw, w,
                                        skip_frames=start_rows * self.nint)
+        self._surface_integrity(raw, hdr)
         return hdr
 
 
@@ -1137,7 +1158,15 @@ def resume_fil_ok(path: str, nif: int, nchans: int, rows: int,
     claim, and POSIX ``truncate`` on a SHORTER file would silently
     EXTEND it with a NUL hole — a crash-corrupted (or replaced) product
     must restart fresh instead (the ``resume_target_ok`` discipline of
-    blit/io/fbh5.py, applied to the flat format; ISSUE 12 satellite)."""
+    blit/io/fbh5.py, applied to the flat format; ISSUE 12 satellite).
+
+    When a manifest sidecar exists the length check is UPGRADED to
+    content verification (ISSUE 13): the claimed region's digest must
+    match the bytes on disk — a torn write *inside* the claim, a
+    tampered sidecar, or a replaced product all fail closed (fresh
+    start) where the byte-length probe alone would have resumed onto
+    corrupt spectra.  No manifest keeps the length-only behavior
+    (legacy products stay resumable)."""
     from blit.io.sigproc import read_fil_header
 
     try:
@@ -1145,8 +1174,13 @@ def resume_fil_ok(path: str, nif: int, nchans: int, rows: int,
         size = os.path.getsize(path)
     except (OSError, ValueError):
         return False
-    need = off + rows * nif * nchans * np.dtype(dtype).itemsize
-    return size >= need
+    row_bytes = nif * nchans * np.dtype(dtype).itemsize
+    if size < off + rows * row_bytes:
+        return False
+    from blit import integrity
+
+    return integrity.verify_claim(path, rows, fmt="fil",
+                                  row_bytes=row_bytes) is not False
 
 
 class ResumableFilWriter:
@@ -1166,6 +1200,7 @@ class ResumableFilWriter:
     def __init__(self, path: str, header: Dict, nif: int, nchans: int,
                  start_rows: int, nint: int, cursor: "ReductionCursor",
                  dtype=np.float32):
+        from blit import integrity
         from blit.io.sigproc import read_fil_header, write_fil
 
         self.path = path
@@ -1174,6 +1209,10 @@ class ResumableFilWriter:
         self._nchans = nchans
         self.dtype = np.dtype(dtype)
         self.cursor = cursor
+        row_bytes = nif * nchans * self.dtype.itemsize
+        self._mf = integrity.ManifestWriter(
+            path, "fil", row_bytes=row_bytes,
+            writer=type(self).__name__)
         if start_rows > 0 and os.path.exists(path):
             # The cursor may record more frames than the agreed restart
             # point (the mesh writer restarts at a pod-wide minimum): clamp
@@ -1181,15 +1220,24 @@ class ResumableFilWriter:
             # append would leave it claiming bytes the truncate dropped.
             _, off = read_fil_header(path)
             with open(path, "r+b") as f:
-                f.truncate(off + start_rows * nif * nchans
-                           * self.dtype.itemsize)
+                f.truncate(off + start_rows * row_bytes)
             cursor.frames_done = start_rows * nint
             cursor.save(path)
+            # Rebuild the manifest's running CRC over the truncated file
+            # (one pass; callers already content-verified the claim via
+            # resume_fil_ok) so every later claim digests correctly.
+            self._mf.data_offset = off
+            self._mf.fold_path(path)
+            self._mf.claim(start_rows)
+            self._mf.save()
         else:
             start_rows = 0
             write_fil(path, header, np.zeros((0, nif, nchans), self.dtype))
             cursor.frames_done = 0
             cursor.save(path)
+            self._mf.data_offset = os.path.getsize(path)
+            self._mf.fold_path(path)
+            self._mf.save()
         self._f = open(path, "ab")
         self.nsamps = start_rows
 
@@ -1202,14 +1250,26 @@ class ResumableFilWriter:
         self._f.flush()
         os.fsync(self._f.fileno())
         self.nsamps += slab.shape[0]
+        # Manifest BETWEEN the data fsync and the cursor claim
+        # (ISSUE 13): the ledger then always holds an entry for every
+        # row count a cursor can legally claim — a crash between the
+        # two leaves the manifest AHEAD of the cursor (a harmless extra
+        # entry), never behind (an unverifiable gap a resume would
+        # truncate into).
+        self._mf.fold(slab)
+        self._mf.claim(self.nsamps)
+        self._mf.save()
         self.cursor.frames_done = self.nsamps * self._nint
         self.cursor.save(self.path)
 
     def close(self) -> None:
         """Finish: the sidecar's absence is the completeness marker.
         The cursor names its own sidecar path — StreamCursor rides this
-        writer with a ``.stream-cursor`` sibling (blit/stream/cursor.py)."""
+        writer with a ``.stream-cursor`` sibling (blit/stream/cursor.py).
+        The manifest flips to complete (whole-file digest) and STAYS —
+        it is the finished product's verification surface (blit fsck)."""
         self._f.close()
+        self._mf.publish()
         sidecar = self.cursor.path_for(self.path)
         if os.path.exists(sidecar):
             os.unlink(sidecar)

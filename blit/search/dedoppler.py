@@ -459,6 +459,23 @@ class DedopplerReducer:
             )
             resuming = False
         if resuming:
+            # Content verification of the claim (ISSUE 13): the byte-
+            # length probe above cannot see a flipped byte INSIDE the
+            # claimed lines or a tampered sidecar — the manifest's claim
+            # ledger can.  False = fail closed (fresh start); a product
+            # without a manifest keeps the length-only behavior.
+            from blit import integrity
+
+            if integrity.verify_claim(out_path, cur.windows_done,
+                                      fmt="hits") is False:
+                log.warning(
+                    "resume target %s fails its claimed-region digest "
+                    "(torn write or tampered sidecar); discarding %d "
+                    "claimed windows and starting fresh",
+                    out_path, cur.windows_done,
+                )
+                resuming = False
+        if resuming:
             log.info("resuming %s at window %d", out_path, cur.windows_done)
         else:
             size, mtime_ns = ReductionCursor.stat_raw(paths)

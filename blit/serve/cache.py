@@ -163,6 +163,13 @@ class ProductCache:
         }
         if root is not None:
             os.makedirs(root, exist_ok=True)
+            # Integrity plane (ISSUE 13): the disk tier's quarantine dir
+            # joins the /healthz watch set — a serve process whose cache
+            # grew a quarantine reports degraded until triaged.
+            from blit import integrity
+
+            integrity.watch_quarantine(
+                os.path.join(root, integrity.QUARANTINE_DIR))
 
     # -- paths -------------------------------------------------------------
     def data_path(self, fp: str) -> str:
@@ -209,11 +216,21 @@ class ProductCache:
         self._ram_used += nbytes
 
     # -- disk tier ---------------------------------------------------------
-    def _disk_publish(self, fp: str, header: Dict, data: np.ndarray) -> None:
+    def _disk_publish(self, fp: str, header: Dict, data: np.ndarray,
+                      recipe: Optional[Dict] = None) -> None:
         """Atomic publish: data file first, sidecar last, both via
         write-temp-``os.replace`` — the sidecar's existence marks a
         complete entry.  Raises on failure (the caller downgrades to a
-        RAM/serve-only result and counts it)."""
+        RAM/serve-only result and counts it).
+
+        The meta sidecar carries the entry's CONTENT digest (a CRC over
+        the published file's bytes, ISSUE 13) — loads and the background
+        scrubber verify it, turning the structural resume probe into
+        content verification — plus the optional ``recipe`` (the
+        serve request's knob surface) so ``blit fsck --repair`` can
+        re-derive a quarantined entry: the fingerprint is already a
+        content-addressed recipe key, the recipe makes it executable."""
+        from blit import integrity
         from blit.io import write_fbh5
 
         faults.fire("cache.publish", key=fp)
@@ -223,13 +240,20 @@ class ProductCache:
         mtmp = self.meta_path(fp) + suffix
         try:
             write_fbh5(dtmp, header, np.ascontiguousarray(data))
+            file_crc = integrity.crc32_file(dtmp)
+            file_bytes = os.path.getsize(dtmp)
             os.replace(dtmp, self.data_path(fp))
+            meta = {"fingerprint": fp, "nsamps": int(data.shape[0]),
+                    "nifs": int(data.shape[1]),
+                    "nchans": int(data.shape[2]),
+                    "nbytes": int(data.nbytes),
+                    "crc32": integrity.hex_crc(file_crc),
+                    "file_bytes": int(file_bytes),
+                    "header": _jsonable(header)}
+            if recipe is not None:
+                meta["recipe"] = recipe
             with open(mtmp, "w") as f:
-                json.dump({"fingerprint": fp, "nsamps": int(data.shape[0]),
-                           "nifs": int(data.shape[1]),
-                           "nchans": int(data.shape[2]),
-                           "nbytes": int(data.nbytes),
-                           "header": _jsonable(header)}, f)
+                json.dump(meta, f)
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(mtmp, self.meta_path(fp))
@@ -309,6 +333,27 @@ class ProductCache:
             log.warning("cache entry %s is unreadable; evicting", fp[:16])
             self._disk_evict(fp, "corrupt")
             return None
+        # Content verification (ISSUE 13): the structural probe above
+        # cannot see a flipped byte inside a structurally valid file —
+        # the published content digest can.  BLIT_VERIFY_CACHE=0 is the
+        # escape hatch; entries published before the digest existed
+        # keep the structural-probe behavior.
+        from blit import integrity
+
+        want = integrity.parse_crc(meta.get("crc32"))
+        if want is not None and integrity.cache_verify_enabled():
+            t0 = time.perf_counter()
+            got = integrity.crc32_file(self.data_path(fp))
+            integrity.observe_verify(time.perf_counter() - t0,
+                                     self.timeline)
+            if got != want:
+                integrity.incr("integrity.cache.corrupt")
+                log.warning(
+                    "cache entry %s fails its content digest (%s != "
+                    "%s); evicting", fp[:16], integrity.hex_crc(got),
+                    meta["crc32"])
+                self._disk_evict(fp, "corrupt")
+                return None
         try:
             data = read_fbh5_data(self.data_path(fp))
         except Exception:  # noqa: BLE001 — corrupt past the probe: evict
@@ -343,13 +388,17 @@ class ProductCache:
         self._count("miss")
         return None
 
-    def put(self, fp: str, header: Dict, data: np.ndarray) -> np.ndarray:
+    def put(self, fp: str, header: Dict, data: np.ndarray,
+            *, recipe: Optional[Dict] = None) -> np.ndarray:
         """Publish a finished product under ``fp`` (RAM, then disk spill).
         A disk-publish failure (including an injected ``cache.publish``
         fault) downgrades to a RAM-only entry — the result in hand is
         still correct and MUST still be served (count
         ``publish.error``).  Returns the read-only array the cache will
-        serve, so the publisher and later hitters share bytes."""
+        serve, so the publisher and later hitters share bytes.
+        ``recipe`` (the serve request's knob surface, ISSUE 13) rides
+        the meta sidecar so ``blit fsck --repair`` can re-derive the
+        entry after a quarantine."""
         data = _frozen(data)
         header = dict(header)
         with self._lock:
@@ -357,7 +406,7 @@ class ProductCache:
             self.counts["publish"] += 1
         if self.root is not None:
             try:
-                self._disk_publish(fp, header, data)
+                self._disk_publish(fp, header, data, recipe=recipe)
             except Exception as e:  # noqa: BLE001 — serve-path must survive
                 log.warning("disk publish of %s failed: %s", fp[:16], e)
                 self._count("publish.error")
@@ -370,6 +419,53 @@ class ProductCache:
                     except OSError:
                         pass
         return data
+
+    def verify_entry(self, fp: str, quarantine: bool = False
+                     ) -> Optional[bool]:
+        """Content-verify one completed disk entry (the scrubber's and
+        ``blit fsck``'s unit of work, ISSUE 13).  Returns None when the
+        entry does not exist, True when it verifies, False when it does
+        not — in which case it is QUARANTINED (moved into
+        ``<root>/.quarantine/``, inspectable, no longer servable) when
+        asked, else evicted; either way counted ``evict.corrupt``."""
+        from blit import integrity
+
+        if self.root is None:
+            return None
+        mpath = self.meta_path(fp)
+        if not os.path.exists(mpath):
+            return None
+        ok = True
+        try:
+            with open(mpath) as f:
+                meta = json.load(f)
+            want = integrity.parse_crc(meta.get("crc32"))
+            if want is not None:
+                ok = integrity.crc32_file(self.data_path(fp)) == want
+            else:
+                from blit.io.fbh5 import resume_target_ok
+
+                ok = resume_target_ok(
+                    self.data_path(fp), int(meta["nifs"]),
+                    int(meta["nchans"]), int(meta["nsamps"]))
+        except (OSError, ValueError, KeyError, TypeError):
+            ok = False  # torn meta / missing data: fail closed
+        if ok:
+            return True
+        integrity.incr("integrity.cache.corrupt")
+        log.warning("cache entry %s failed verification; %s", fp[:16],
+                    "quarantining" if quarantine else "evicting")
+        if quarantine:
+            integrity.quarantine_move(
+                [self.data_path(fp), mpath], self.root)
+            with self._lock:
+                old = self._ram.pop(fp, None)
+                if old is not None:
+                    self._ram_used -= old[2]
+            self._count("evict.corrupt")
+        else:
+            self._disk_evict(fp, "corrupt")
+        return False
 
     def contains(self, fp: str) -> bool:
         with self._lock:

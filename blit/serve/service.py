@@ -176,6 +176,39 @@ class ProductRequest:
     def raw_source(self):
         return list(self.raw) if isinstance(self.raw, tuple) else self.raw
 
+    # Recipe fields a cache meta records (ISSUE 13): enough to rebuild
+    # the request — and hence re-derive the entry — after a quarantine.
+    _RECIPE_FIELDS = ("product", "nfft", "nint", "stokes", "fqav_by",
+                     "dtype", "kind", "window_spectra", "snr_threshold",
+                     "top_k", "max_drift_bins")
+
+    def recipe(self) -> Dict:
+        """The JSON-able re-derivation recipe of this ask — stored in the
+        disk cache's meta sidecar next to the fingerprint, so ``blit
+        fsck --repair`` can rebuild a quarantined entry through the same
+        reduce path the serve layer takes on a miss (the fingerprint is
+        already a content-addressed recipe KEY; this makes it
+        executable).  Live sessions are never cached, so never carry
+        recipes."""
+        d: Dict = {"raw": self.raw_source}
+        for k in self._RECIPE_FIELDS:
+            v = getattr(self, k)
+            if v is not None:
+                d[k] = v
+        if d.get("product") is not None:
+            # product= and explicit nfft/nint are mutually exclusive at
+            # construction; the preset carries the pair.
+            d.pop("nfft", None)
+            d.pop("nint", None)
+        return d
+
+    @classmethod
+    def from_recipe(cls, recipe: Dict) -> "ProductRequest":
+        """Rebuild a request from a cache meta's recipe (unknown keys
+        ignored so older blits can read newer recipes)."""
+        kw = {k: recipe[k] for k in cls._RECIPE_FIELDS if k in recipe}
+        return cls(raw=recipe["raw"], **kw)
+
 
 class _Flight:
     """One single-flight group: every ticket for the same fingerprint
@@ -264,6 +297,22 @@ class ProductService:
         if self._publisher is not None:
             self._publisher.watch(self.timeline)
             self._publisher.slo.attach_scheduler(self.scheduler)
+        # Background integrity scrubbing (ISSUE 13): opt-in via
+        # BLIT_SCRUB_INTERVAL / SiteConfig.scrub_interval_s — samples
+        # disk-tier entries between requests under a bytes/s budget,
+        # quarantining what fails and publishing integrity.scrub.*
+        # through the monitor plane.
+        from blit.config import scrub_defaults
+
+        self._scrubber = None
+        sd = scrub_defaults(config)
+        if sd["enabled"] and self.cache.root is not None:
+            from blit.integrity import Scrubber
+
+            self._scrubber = Scrubber(
+                self.cache, interval_s=sd["interval_s"],
+                bytes_per_s=sd["bytes_per_s"],
+                timeline=self.timeline).start()
 
     # -- submission --------------------------------------------------------
     def submit(
@@ -434,7 +483,8 @@ class ProductService:
                 if sp is not None and tuned:
                     sp.attrs = dict(sp.attrs or {}, tuned=tuned)
                 header, data = reducer.reduce(request.raw_source)
-            data = self.cache.put(fp, header, data)
+            data = self.cache.put(fp, header, data,
+                                  recipe=request.recipe())
             self._finish(fp, flight, result=(header, data))
             return header, data
         except BaseException as e:  # noqa: BLE001 — per-ticket delivery
@@ -551,6 +601,9 @@ class ProductService:
         return out
 
     def close(self, timeout: Optional[float] = 30.0) -> None:
+        if self._scrubber is not None:
+            self._scrubber.close()
+            self._scrubber = None
         if self._publisher is not None:
             self._publisher.unwatch(self.timeline)
             self._publisher.slo.detach_scheduler(self.scheduler)

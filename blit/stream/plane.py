@@ -674,6 +674,14 @@ def stream_search(source: ChunkSource, out_path: str, *,
             and os.path.exists(out_path)
             and os.path.getsize(out_path) >= cur.byte_offset
         )
+        if resuming:
+            # Content verification of the claim (ISSUE 13): the
+            # byte-length probe cannot see a flip INSIDE the claimed
+            # lines or a tampered sidecar — fail closed to fresh.
+            from blit import integrity
+
+            resuming = integrity.verify_claim(
+                out_path, cur.windows_done, fmt="hits") is not False
         if not resuming:
             cur = StreamCursor.fresh(red, session, "hits")
     live = LiveRawStream(
