@@ -59,6 +59,7 @@ __all__ = [
     "ProductCache",
     "Scheduler",
     "Overloaded",
+    "FleetFrontDoor",
     "DedopplerReducer",
     "Hit",
     "stream_reduce",
@@ -73,6 +74,7 @@ _SERVE_EXPORTS = (
     "ProductCache",
     "Scheduler",
     "Overloaded",
+    "FleetFrontDoor",
 )
 
 # The search plane's front-door names re-export from blit.search (lazily —

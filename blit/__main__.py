@@ -27,7 +27,16 @@ Commands:
   serve-bench
              Replay a zipfian request mix against a ProductService
              (blit/serve) over synthetic RAW inputs and report hit-rate,
-             coalesce counts, and p50/p99 queue wait.
+             coalesce counts, and p50/p99 queue wait.  ``--fleet``
+             replays through a REAL multi-process fleet front door
+             (ISSUE 14: consistent-hash routing, hedged reads, deadline
+             propagation) and reports per-tier hit-rate, SLO attainment
+             and the hedge counters.
+  fleet-peer Run ONE serving peer of the fleet (ISSUE 14): a
+             ProductService over stdlib HTTP (/product /warm /stats
+             /healthz /metrics /drain) beating a heartbeat lease;
+             SIGTERM drains gracefully — refuse new, finish in-flight,
+             release live capacity holds.
   ingest-bench
              File→product throughput probe of the asynchronous output
              plane (blit/outplane): per-stage table with the readback/
@@ -489,11 +498,146 @@ def _cmd_inventory(args: argparse.Namespace) -> int:
     return 0
 
 
+def _cmd_fleet_peer(args: argparse.Namespace) -> int:
+    """``blit fleet-peer`` (ISSUE 14): one serving peer of the fleet —
+    a ProductService behind the HTTP wire (``/product``, ``/warm``,
+    ``/stats``, ``/healthz``, ``/metrics``, ``/drain``), beating a
+    heartbeat lease the front door watches.  SIGTERM/SIGINT drain
+    gracefully: refuse new work, finish in-flight (releasing live
+    capacity holds), then exit.  ``--port 0`` binds an ephemeral port,
+    published via ``--port-file`` (atomic write) for the spawner."""
+    import os
+    import threading
+
+    from blit.observability import Timeline
+    from blit.serve import ProductCache, ProductService, Scheduler
+    from blit.serve.http import PeerServer, install_drain_handler
+
+    tl = Timeline()
+    service = ProductService(
+        cache=ProductCache(args.cache_dir, ram_bytes=args.ram_bytes,
+                           timeline=tl),
+        scheduler=Scheduler(max_concurrency=args.concurrency,
+                            queue_depth=args.queue_depth, timeline=tl,
+                            retry_seed=args.retry_seed),
+        timeline=tl,
+    )
+    server = PeerServer(service, name=args.name, port=args.port,
+                        host=args.host,
+                        lease_dir=args.lease_dir, proc=args.proc,
+                        beat_interval_s=args.beat_interval).start()
+    stop = threading.Event()
+
+    def _drain():
+        server.drain(timeout=args.drain_timeout)
+        stop.set()
+
+    uninstall = install_drain_handler(_drain, exit_after=False)
+    if args.port_file:
+        tmp = args.port_file + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(str(server.port))
+        os.replace(tmp, args.port_file)
+    print(json.dumps({"name": args.name, "url": server.url,
+                      "pid": os.getpid(), "lease_dir": args.lease_dir,
+                      "proc": args.proc}), flush=True)
+    try:
+        stop.wait()
+    except KeyboardInterrupt:
+        service.drain(timeout=args.drain_timeout)
+    uninstall()
+    server.close()
+    service.close()
+    return 0
+
+
+def _spawn_fleet_peers(td: str, npeers: int, *, concurrency: int,
+                       queue_depth: int, ram_bytes: int,
+                       beat_interval_s: float = 0.2,
+                       bringup_timeout_s: float = 120.0):
+    """Bring up ``npeers`` REAL ``blit fleet-peer`` subprocesses (the
+    bench/chaos rig): per-peer cache dirs + one shared lease dir under
+    ``td``, ephemeral ports published through port files.  Returns
+    ``(procs, peers, lease_dir)`` with ``procs`` a list of
+    ``(Popen, logfile)`` pairs and ``peers`` the name→url map the
+    front door takes."""
+    import os
+    import subprocess
+    import time as _time
+
+    from blit.serve.http import wait_http_ready
+
+    lease_dir = os.path.join(td, "leases")
+    procs, peers = [], {}
+    for i in range(npeers):
+        port_file = os.path.join(td, f"peer{i}.port")
+        cmd = [sys.executable, "-m", "blit", "fleet-peer",
+               "--name", f"peer{i}",
+               "--cache-dir", os.path.join(td, f"cache{i}"),
+               "--lease-dir", lease_dir, "--proc", str(i),
+               "--port", "0", "--port-file", port_file,
+               "--concurrency", str(concurrency),
+               "--queue-depth", str(queue_depth),
+               "--ram-bytes", str(ram_bytes),
+               "--beat-interval", str(beat_interval_s),
+               "--retry-seed", str(i)]
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        logf = open(os.path.join(td, f"peer{i}.log"), "w")
+        procs.append((subprocess.Popen(cmd, stdout=logf, stderr=logf,
+                                       env=env), logf))
+    try:
+        for i in range(npeers):
+            port_file = os.path.join(td, f"peer{i}.port")
+            deadline = _time.monotonic() + bringup_timeout_s
+            while not os.path.exists(port_file):
+                if procs[i][0].poll() is not None:
+                    raise RuntimeError(
+                        f"peer{i} died at bring-up "
+                        f"(rc={procs[i][0].returncode}; see peer{i}.log)")
+                if _time.monotonic() > deadline:
+                    raise TimeoutError(f"peer{i} port file never appeared")
+                _time.sleep(0.05)
+            with open(port_file) as f:
+                url = f"http://127.0.0.1:{int(f.read().strip())}"
+            wait_http_ready(url, timeout_s=bringup_timeout_s)
+            peers[f"peer{i}"] = url
+    except BaseException:
+        _reap_fleet_peers(procs)
+        raise
+    return procs, peers, lease_dir
+
+
+def _reap_fleet_peers(procs) -> None:
+    """Terminate (then kill) peer subprocesses and close their logs —
+    every exit path of the bench/chaos rigs."""
+    for p, _ in procs:
+        if p.poll() is None:
+            p.terminate()
+    for p, logf in procs:
+        try:
+            p.wait(timeout=10)
+        except Exception:  # noqa: BLE001 — escalate to SIGKILL
+            p.kill()
+            try:
+                p.wait(timeout=5)
+            except Exception:  # noqa: BLE001 — nothing left to do
+                pass
+        try:
+            logf.close()
+        except OSError:
+            pass
+
+
 def _cmd_serve_bench(args: argparse.Namespace) -> int:
     """Drive a ProductService with a zipfian request replay — the serving
     layer's dispatch-overhead probe (ISSUE 3): most traffic re-asks for a
     few hot products, so the report's hit-rate/coalesce/queue-wait numbers
-    are what a multi-tenant deployment would see."""
+    are what a multi-tenant deployment would see.  ``--fleet`` replays
+    the same mix through a REAL multi-process fleet front door instead
+    (ISSUE 14): N ``fleet-peer`` subprocesses behind consistent-hash
+    routing, reporting per-tier hit-rate, SLO attainment and the hedge
+    counters."""
     import math
     import os
     import random
@@ -509,8 +653,11 @@ def _cmd_serve_bench(args: argparse.Namespace) -> int:
         ProductService,
         Scheduler,
     )
+    from blit.serve.http import install_drain_handler
     from blit.testing import synth_raw
 
+    if args.fleet:
+        return _serve_bench_fleet(args)
     rng = random.Random(args.seed)
     tl = Timeline()
     with tempfile.TemporaryDirectory(prefix="blit-serve-bench-") as td:
@@ -528,9 +675,16 @@ def _cmd_serve_bench(args: argparse.Namespace) -> int:
             cache=ProductCache(cache_dir, ram_bytes=args.ram_bytes,
                                timeline=tl),
             scheduler=Scheduler(max_concurrency=args.concurrency,
-                                queue_depth=args.queue_depth, timeline=tl),
+                                queue_depth=args.queue_depth, timeline=tl,
+                                retry_seed=args.seed),
             timeline=tl,
         )
+        # Graceful-shutdown satellite (ISSUE 14): SIGTERM/SIGINT drains
+        # the scheduler — in-flight jobs finish, queued ones deliver
+        # Cancelled, and kind="stream" capacity holds release instead
+        # of leaking on interpreter exit.
+        uninstall_signals = install_drain_handler(
+            lambda: service.drain(timeout=30.0))
         # Zipfian popularity over the distinct products: p(k) ∝ 1/(k+1)^s.
         weights = [1.0 / math.pow(k + 1, args.zipf_s)
                    for k in range(args.distinct)]
@@ -565,6 +719,7 @@ def _cmd_serve_bench(args: argparse.Namespace) -> int:
         for t in threads:
             t.join()
         wall = _time.perf_counter() - t0
+        uninstall_signals()
         service.close()
         stats = service.stats()
         qw = stats["queue_wait"]
@@ -587,6 +742,171 @@ def _cmd_serve_bench(args: argparse.Namespace) -> int:
             "errors": errors[:5],
         }))
         return 1 if errors else 0
+
+
+def _serve_bench_fleet(args: argparse.Namespace) -> int:
+    """``serve-bench --fleet`` (ISSUE 14): replay the zipfian mix at
+    accelerated clock through a REAL fleet — N ``fleet-peer``
+    subprocesses behind an in-process :class:`FleetFrontDoor` (the HTTP
+    hop is at the peer boundary, where the bytes actually move).  The
+    report is what a deployment watches: per-tier hit-rate across the
+    fleet, SLO attainment against ``--slo-ms``, request p50/p99, and
+    the hedge/failover counters with the duplicate-compute bound."""
+    import math
+    import os
+    import random
+    import tempfile
+    import threading
+    import time as _time
+
+    from blit.observability import HistogramStats, Timeline
+    from blit.serve import Overloaded, ProductRequest
+    from blit.serve.fleet import FleetFrontDoor
+    from blit.serve.http import http_json, install_drain_handler
+    from blit.serve.scheduler import DeadlineExpired
+    from blit.testing import synth_raw
+
+    rng = random.Random(args.seed)
+    tl = Timeline()
+    with tempfile.TemporaryDirectory(prefix="blit-fleet-bench-") as td:
+        ntime = (8 + 3) * args.nfft  # 8 PFB frames at ntap=4
+        reqs = []
+        for i in range(args.distinct):
+            path = os.path.join(td, f"bench{i:03d}.raw")
+            synth_raw(path, nblocks=1, obsnchan=2, ntime_per_block=ntime,
+                      seed=i)
+            reqs.append(ProductRequest(raw=path, nfft=args.nfft, nint=1))
+        procs, peers, lease_dir = _spawn_fleet_peers(
+            td, args.peers, concurrency=args.concurrency,
+            queue_depth=args.queue_depth, ram_bytes=args.ram_bytes)
+        door = FleetFrontDoor(
+            peers, lease_dir=lease_dir, timeline=tl,
+            replicas=args.replicas, peer_ttl_s=args.peer_ttl,
+            poll_s=min(0.1, args.peer_ttl / 4),
+            hedge_floor_s=args.hedge_floor_ms / 1e3,
+            request_timeout_s=60.0).start()
+        uninstall = install_drain_handler(lambda: door.drain())
+        weights = [1.0 / math.pow(k + 1, args.zipf_s)
+                   for k in range(args.distinct)]
+        picks = rng.choices(range(args.distinct), weights=weights,
+                            k=args.requests)
+        lat = HistogramStats()
+        slo_s = args.slo_ms / 1e3
+        lock = threading.Lock()
+        attained = [0]
+        rejected = [0]
+        expired = [0]
+        errors: list = []
+        it = iter(picks)
+
+        def client_loop(cid: int) -> None:
+            while True:
+                with lock:
+                    k = next(it, None)
+                if k is None:
+                    return
+                t = _time.perf_counter()
+                ok = False
+                try:
+                    door.get(reqs[k], client=f"client{cid}",
+                             deadline_s=args.deadline)
+                    ok = True
+                except DeadlineExpired:
+                    with lock:
+                        expired[0] += 1
+                except Overloaded as e:
+                    with lock:
+                        rejected[0] += 1
+                    _time.sleep(min(0.25, e.retry_after_s))
+                except Exception as e:  # noqa: BLE001 — reported below
+                    with lock:
+                        errors.append(repr(e))
+                dt = _time.perf_counter() - t
+                lat.observe(dt)
+                # SLO attainment counts SERVED requests only: a fleet
+                # that 503s everything in a millisecond must read as
+                # 0% attained, not 100%.
+                if ok and dt <= slo_s:
+                    with lock:
+                        attained[0] += 1
+
+        try:
+            t0 = _time.perf_counter()
+            threads = [threading.Thread(target=client_loop, args=(c,))
+                       for c in range(args.clients)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = _time.perf_counter() - t0
+            tiers = {"hit.ram": 0, "hit.disk": 0, "miss": 0}
+            per_peer = {}
+            for name, url in sorted(peers.items()):
+                try:
+                    _, _, s = http_json("GET", url, "/stats", timeout=5.0)
+                except OSError:
+                    continue
+                c = (s.get("cache") or {})
+                for k in tiers:
+                    tiers[k] += int(c.get(k, 0))
+                per_peer[name] = {
+                    "hit_rate": s.get("hit_rate"),
+                    "scheduled": s.get("scheduled"),
+                    "coalesced": s.get("coalesced"),
+                }
+            served_tier = tiers["hit.ram"] + tiers["hit.disk"]
+            total_tier = served_tier + tiers["miss"]
+            fstats = door.stats()
+            c = fstats["counters"]
+            hedges = c.get("fleet.hedge", 0)
+            report = {
+                "fleet": True,
+                "requests": args.requests,
+                "distinct": args.distinct,
+                "clients": args.clients,
+                "peers": args.peers,
+                "replicas": args.replicas,
+                "zipf_s": args.zipf_s,
+                "wall_s": round(wall, 3),
+                "rps": round(args.requests / wall, 1) if wall else None,
+                "tiers": tiers,
+                "hit_rate": (round(served_tier / total_tier, 4)
+                             if total_tier else 0.0),
+                "hit_rate_ram": (round(tiers["hit.ram"] / total_tier, 4)
+                                 if total_tier else 0.0),
+                "hit_rate_disk": (round(tiers["hit.disk"] / total_tier, 4)
+                                  if total_tier else 0.0),
+                "slo": {"target_s": slo_s,
+                        "attained": round(attained[0] / args.requests, 4)
+                        if args.requests else None},
+                "request_p50_s": round(lat.percentile(0.50), 6),
+                "request_p99_s": round(lat.percentile(0.99), 6),
+                "hedge": {
+                    "hedges": hedges,
+                    "wins": c.get("fleet.hedge.win", 0),
+                    "dup_done": c.get("fleet.hedge.dup_done", 0),
+                    "rate": (round(hedges / args.requests, 4)
+                             if args.requests else 0.0),
+                    # The acceptance bound: each hedge adds at most ONE
+                    # duplicate dispatch, so compute on the hedged slice
+                    # is <= 2x by construction; dup_ratio reports how
+                    # much actually ran to completion.
+                    "dup_ratio": (round(
+                        c.get("fleet.hedge.dup_done", 0) / hedges, 4)
+                        if hedges else 0.0),
+                },
+                "failovers": c.get("fleet.failover", 0),
+                "rejected_overloaded": rejected[0],
+                "deadline_expired": expired[0],
+                "per_peer": per_peer,
+                "errors": errors[:5],
+            }
+            print(json.dumps(report))
+        finally:
+            uninstall()
+            door.close()
+            _reap_fleet_peers(procs)
+    return 1 if errors else 0
 
 
 def _monitor_from_flags(args: argparse.Namespace):
@@ -1141,6 +1461,211 @@ def _chaos_corrupt(args: argparse.Namespace, work: str,
     return 0 if (identical and bad_blocks >= 1) else 1
 
 
+def _chaos_fleet(args: argparse.Namespace, work: str, report: dict) -> int:
+    """``blit chaos --fleet`` (ISSUE 14 tentpole): break a REAL
+    multi-process serving fleet mid-replay and assert the front door's
+    recovery contract end to end:
+
+    - the failed peer (SIGKILL / SIGSTOP-wedge / SIGSTOP+SIGCONT
+      partition) is DETECTED within the lease TTL and ejected,
+    - its key range re-routes: every request completes,
+    - every served product is BYTE-IDENTICAL to a single-process
+      oracle reduction,
+    - ``/healthz`` degrades honestly and (partition) recovers,
+    - post-recovery hit-rate returns to within 10% of pre-kill.
+
+    The victim is the OWNER of the hottest product — the worst case for
+    the cache-warm replication story."""
+    import math
+    import os
+    import random
+    import signal
+    import time as _time
+
+    import numpy as np
+
+    from blit.observability import Timeline
+    from blit.serve import Overloaded, ProductRequest
+    from blit.serve.cache import fingerprint_for
+    from blit.serve.fleet import FleetError, FleetFrontDoor
+    from blit.serve.http import http_json
+    from blit.serve.scheduler import DeadlineExpired
+    from blit.testing import synth_raw
+
+    rng = random.Random(args.seed)
+    nfft = args.nfft
+    distinct = max(2, args.fleet_distinct)
+    total = max(30, args.fleet_requests)
+    ntime = (8 + 3) * nfft
+    reqs, oracle = [], {}
+    for i in range(distinct):
+        path = os.path.join(work, f"prod{i:02d}.raw")
+        synth_raw(path, nblocks=1, obsnchan=2, ntime_per_block=ntime,
+                  seed=args.seed + i)
+        req = ProductRequest(raw=path, nfft=nfft, nint=1)
+        reqs.append(req)
+        # The single-process oracle: the same reduction, no fleet.
+        _, data = req.reducer().reduce(path)
+        oracle[i] = np.asarray(data)
+    procs, peers, lease_dir = _spawn_fleet_peers(
+        work, args.peers, concurrency=2, queue_depth=32,
+        ram_bytes=64 << 20, beat_interval_s=min(0.2, args.lease_ttl / 5))
+    tl = Timeline()
+    door = FleetFrontDoor(
+        peers, lease_dir=lease_dir, timeline=tl, replicas=args.replicas,
+        peer_ttl_s=args.lease_ttl, poll_s=args.poll,
+        health_poll_s=max(args.poll, 0.5),
+        hedge_floor_s=0.05, request_timeout_s=10.0).start()
+
+    fp0 = fingerprint_for(reqs[0].reducer(), reqs[0].raw_source)
+    victim = door.ring.owners(fp0)[0]
+    victim_proc = procs[int(victim.removeprefix("peer"))][0]
+    weights = [1.0 / math.pow(k + 1, 1.2) for k in range(distinct)]
+    picks = rng.choices(range(distinct), weights=weights, k=total)
+    third = total // 3
+
+    def cache_totals() -> dict:
+        out = {}
+        for name, url in peers.items():
+            try:
+                _, _, s = http_json("GET", url, "/stats", timeout=2.0)
+            except OSError:
+                continue
+            c = s.get("cache") or {}
+            out[name] = (c.get("hit.ram", 0) + c.get("hit.disk", 0),
+                         c.get("miss", 0))
+        return out
+
+    def window_hit_rate(before: dict, after: dict):
+        """Hit rate of the interval, over peers alive in BOTH samples
+        (a SIGKILLed peer's counters vanish mid-drill)."""
+        dh = dm = 0
+        for name, (h1, m1) in after.items():
+            if name not in before:
+                continue
+            h0, m0 = before[name]
+            dh += max(0, h1 - h0)
+            dm += max(0, m1 - m0)
+        return (dh / (dh + dm)) if dh + dm else None
+
+    failed: list = []
+    diffs: list = []
+
+    def run_slice(idxs) -> None:
+        for k in idxs:
+            for _attempt in range(8):
+                try:
+                    _, d = door.get(reqs[k], client="chaos")
+                except Overloaded as e:
+                    _time.sleep(min(0.25, e.retry_after_s))
+                    continue
+                except (FleetError, DeadlineExpired, OSError):
+                    # Transient while the failure is being detected:
+                    # back off a beat and retry — a real client's loop.
+                    _time.sleep(0.2)
+                    continue
+                if not np.array_equal(np.asarray(d), oracle[k]):
+                    diffs.append(k)
+                failed_here = False
+                break
+            else:
+                failed_here = True
+            if failed_here:
+                failed.append(k)
+
+    try:
+        run_slice(picks[:third])                     # warm the fleet
+        marks = {"warm": cache_totals()}
+        health_pre = door.health()
+        run_slice(picks[third:2 * third])            # pre-kill window
+        marks["pre_kill"] = cache_totals()
+        hit_pre = window_hit_rate(marks["warm"], marks["pre_kill"])
+
+        sig = (signal.SIGKILL if args.fault == "kill" else signal.SIGSTOP)
+        t_kill = _time.monotonic()
+        victim_proc.send_signal(sig)
+        # Detection: the lease goes stale, the door ejects within the
+        # TTL (+ the watch cadence), traffic re-routes to the replicas.
+        detect_budget = args.lease_ttl * 3 + 5.0
+        while victim in door.ring and \
+                _time.monotonic() - t_kill < detect_budget:
+            _time.sleep(args.poll / 2)
+        detect_s = _time.monotonic() - t_kill
+        detected = victim not in door.ring
+        health_after = door.health()
+
+        tail = picks[2 * third:]
+        run_slice(tail[:len(tail) // 2])             # recovery window
+        marks["recovering"] = cache_totals()
+        run_slice(tail[len(tail) // 2:])             # recovered window
+        marks["recovered"] = cache_totals()
+        hit_post = window_hit_rate(marks["recovering"],
+                                   marks["recovered"])
+
+        rejoined = None
+        if args.fault == "partition":
+            victim_proc.send_signal(signal.SIGCONT)
+            budget = _time.monotonic() + args.lease_ttl * 4 + 5.0
+            while victim not in door.ring and _time.monotonic() < budget:
+                _time.sleep(args.poll / 2)
+            rejoined = victim in door.ring
+        health_final = door.health()
+
+        fstats = door.stats()
+        hit_recovered = (hit_pre is not None and hit_post is not None
+                         and hit_post >= hit_pre - 0.10)
+        report.update(
+            peers=args.peers,
+            replicas=args.replicas,
+            requests=total,
+            distinct=distinct,
+            victim=victim,
+            detected=detected,
+            detect_s=round(detect_s, 3),
+            lease_ttl_s=args.lease_ttl,
+            recovered=detected and not failed,
+            byte_identical=not diffs,
+            differing_products=diffs[:8],
+            failed_requests=len(failed),
+            hit_rate_pre_kill=(round(hit_pre, 4)
+                               if hit_pre is not None else None),
+            hit_rate_post_recovery=(round(hit_post, 4)
+                                    if hit_post is not None else None),
+            hit_rate_recovered=hit_recovered,
+            rejoined=rejoined,
+            healthz={
+                "pre": health_pre["status"],
+                "after_detect": health_after["status"],
+                "final": health_final["status"],
+                "final_reasons": health_final["reasons"],
+            },
+            counters=fstats["counters"],
+            work_dir=work,
+        )
+    finally:
+        door.close()
+        # A SIGSTOPped victim cannot be reaped until it runs again.
+        if args.fault in ("hang", "partition") and \
+                victim_proc.poll() is None:
+            try:
+                victim_proc.send_signal(signal.SIGCONT)
+            except OSError:
+                pass
+        _reap_fleet_peers(procs)
+
+    ok = (report["recovered"] and report["byte_identical"]
+          and report["hit_rate_recovered"]
+          and report["healthz"]["after_detect"] == "degraded"
+          and (rejoined is None or rejoined))
+    report["ok"] = ok
+    body = json.dumps(report)
+    print(body)
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            f.write(body)
+    return 0 if ok else 1
+
+
 def _cmd_chaos(args: argparse.Namespace) -> int:
     """``blit chaos`` (ISSUE 12): run a SEEDED kill/hang schedule
     against a real supervised workload — a multi-process sharded scan
@@ -1161,6 +1686,17 @@ def _cmd_chaos(args: argparse.Namespace) -> int:
     tl = Timeline()
     work = args.work_dir or tempfile.mkdtemp(prefix="blit-chaos-")
     os.makedirs(work, exist_ok=True)
+    if args.fleet:
+        if args.fault == "corrupt":
+            print("chaos --fleet supports kill/hang/partition, "
+                  "not corrupt", file=sys.stderr)
+            return 2
+        report = {"workload": "fleet", "fault": args.fault}
+        return _chaos_fleet(args, work, report)
+    if args.fault == "partition":
+        print("--fault partition requires --fleet (a network partition "
+              "is a serving-fleet failure shape)", file=sys.stderr)
+        return 2
     point = args.point or ("stream.chunk" if args.workload == "stream"
                            else "mesh.window")
     if args.fault == "corrupt":
@@ -1839,7 +2375,59 @@ def main(argv: Optional[List[str]] = None) -> int:
     pb.add_argument("--seed", type=int, default=0)
     pb.add_argument("--disk-cache", action="store_true",
                     help="enable the disk cache tier (tempdir)")
+    pb.add_argument("--fleet", action="store_true",
+                    help="replay through a REAL multi-process fleet "
+                         "front door (ISSUE 14): N fleet-peer "
+                         "subprocesses behind consistent-hash routing")
+    pb.add_argument("--peers", type=int, default=3,
+                    help="fleet peer subprocess count (--fleet)")
+    pb.add_argument("--replicas", type=int, default=2,
+                    help="ring owner-set size R (--fleet)")
+    pb.add_argument("--peer-ttl", type=float, default=3.0,
+                    help="peer heartbeat-lease TTL seconds (--fleet)")
+    pb.add_argument("--slo-ms", type=float, default=500.0,
+                    help="SLO attainment target per request (--fleet)")
+    pb.add_argument("--hedge-floor-ms", type=float, default=50.0,
+                    help="hedge delay before the live p99 exists "
+                         "(--fleet)")
+    pb.add_argument("--deadline", type=float, default=None,
+                    help="per-request deadline_s propagated through "
+                         "the fleet (--fleet)")
     pb.set_defaults(fn=_cmd_serve_bench)
+
+    pfp = sub.add_parser(
+        "fleet-peer",
+        help="run ONE serving peer of the fleet: a ProductService "
+             "over HTTP with lease heartbeats; SIGTERM drains "
+             "gracefully (ISSUE 14)",
+    )
+    pfp.add_argument("--name", default="peer")
+    pfp.add_argument("--port", type=int, default=0,
+                     help="bind port (0 = ephemeral; see --port-file)")
+    pfp.add_argument("--host", default="127.0.0.1",
+                     help="bind address (default loopback; a multi-host "
+                          "fleet binds 0.0.0.0, or this host's fabric "
+                          "address, which is then advertised in .url)")
+    pfp.add_argument("--port-file", default=None,
+                     help="publish the bound port here (atomic write) "
+                          "so a spawner can find an ephemeral bind")
+    pfp.add_argument("--cache-dir", default=None,
+                     help="disk cache tier root (None = RAM-only)")
+    pfp.add_argument("--lease-dir", default=None,
+                     help="shared heartbeat-lease dir the front door "
+                          "watches")
+    pfp.add_argument("--proc", type=int, default=0,
+                     help="this peer's lease proc index")
+    pfp.add_argument("--ram-bytes", type=int, default=256 << 20)
+    pfp.add_argument("--concurrency", type=int, default=2)
+    pfp.add_argument("--queue-depth", type=int, default=64)
+    pfp.add_argument("--retry-seed", type=int, default=None,
+                     help="seed the jittered Retry-After spread")
+    pfp.add_argument("--beat-interval", type=float, default=0.5,
+                     help="lease heartbeat cadence (keep well under "
+                          "the fleet's peer TTL)")
+    pfp.add_argument("--drain-timeout", type=float, default=30.0)
+    pfp.set_defaults(fn=_cmd_fleet_peer)
 
     pc = sub.add_parser(
         "chaos",
@@ -1852,11 +2440,28 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="what to break: a supervised sharded scan, a "
                          "supervised sharded search, or a live consumer")
     pc.add_argument("--fault", default="kill",
-                    choices=["kill", "hang", "corrupt"],
+                    choices=["kill", "hang", "corrupt", "partition"],
                     help="the injected failure mode (corrupt = the "
                          "ISSUE 13 integrity leg: a bit-flipped "
                          "delivered RAW frame under a digest sidecar "
-                         "must be masked, not propagated)")
+                         "must be masked, not propagated; partition = "
+                         "--fleet only: SIGSTOP then SIGCONT, the peer "
+                         "must be ejected AND rejoin)")
+    pc.add_argument("--fleet", action="store_true",
+                    help="break a SERVING fleet instead (ISSUE 14): "
+                         "SIGKILL/SIGSTOP a real fleet-peer subprocess "
+                         "mid-replay and assert detection within the "
+                         "lease TTL, re-route, byte-identity vs a "
+                         "single-process oracle, and hit-rate recovery")
+    pc.add_argument("--peers", type=int, default=3,
+                    help="fleet peer subprocess count (--fleet)")
+    pc.add_argument("--replicas", type=int, default=2,
+                    help="ring owner-set size R (--fleet)")
+    pc.add_argument("--fleet-requests", type=int, default=150,
+                    help="zipfian requests replayed across the drill "
+                         "(--fleet)")
+    pc.add_argument("--fleet-distinct", type=int, default=6,
+                    help="distinct products in the fleet mix (--fleet)")
     pc.add_argument("--after", type=int, default=2,
                     help="fire after this many windows/chunks")
     pc.add_argument("--hang-s", type=float, default=60.0,

@@ -222,6 +222,29 @@ class SiteConfig:
     # (blit.integrity.ingest_verify_enabled / cache_verify_enabled).
     scrub_interval_s: Optional[float] = None
     scrub_bytes_per_s: float = 64e6
+    # Fleet serve plane (blit/serve/fleet.py; ISSUE 14).  fleet_replicas
+    # is the owner-set size R on the consistent-hash ring (owner + R-1
+    # failover/hedge replicas); fleet_vnodes the virtual nodes per peer
+    # (load-spread smoothness); fleet_peer_ttl_s the heartbeat-lease TTL
+    # after which a silent peer is EJECTED from the ring (the detection
+    # budget — the recover-plane lease discipline applied to serving
+    # peers); fleet_poll_s the front door's lease-watch cadence;
+    # fleet_health_poll_s how often the door refreshes each peer's
+    # /healthz body for the aggregated fleet health document.
+    # fleet_hedge_floor_s is the hedged-read delay before a peer has
+    # enough latency history (fleet_hedge_min_n samples) for its live
+    # p99 to drive the hedge; fleet_hot_hits is the per-fingerprint hit
+    # count at which the door cache-warms the replicas (losing the
+    # owner then degrades hit-rate, not correctness).  Per-process
+    # overrides: BLIT_FLEET_* (:func:`fleet_defaults`).
+    fleet_replicas: int = 2
+    fleet_vnodes: int = 128
+    fleet_peer_ttl_s: float = 3.0
+    fleet_poll_s: float = 0.25
+    fleet_health_poll_s: float = 1.0
+    fleet_hedge_floor_s: float = 0.05
+    fleet_hedge_min_n: int = 16
+    fleet_hot_hits: int = 3
 
     def io_retry_policy(self):
         """The :class:`blit.faults.RetryPolicy` for worker-side file I/O —
@@ -451,6 +474,31 @@ def scrub_defaults(config: SiteConfig = DEFAULT) -> Dict:
         "bytes_per_s": float(os.environ.get(
             "BLIT_SCRUB_BYTES_PER_S", config.scrub_bytes_per_s)),
         "enabled": interval is not None,
+    }
+
+
+def fleet_defaults(config: SiteConfig = DEFAULT) -> Dict:
+    """The effective fleet-serve knob set (ISSUE 14): ``config``'s
+    values with per-process ``BLIT_FLEET_*`` environment overrides
+    applied — the :func:`stream_defaults` pattern, resolved at front
+    door construction so drills and deployments retune per run."""
+    return {
+        "replicas": int(os.environ.get(
+            "BLIT_FLEET_REPLICAS", config.fleet_replicas)),
+        "vnodes": int(os.environ.get(
+            "BLIT_FLEET_VNODES", config.fleet_vnodes)),
+        "peer_ttl_s": float(os.environ.get(
+            "BLIT_FLEET_PEER_TTL", config.fleet_peer_ttl_s)),
+        "poll_s": float(os.environ.get(
+            "BLIT_FLEET_POLL", config.fleet_poll_s)),
+        "health_poll_s": float(os.environ.get(
+            "BLIT_FLEET_HEALTH_POLL", config.fleet_health_poll_s)),
+        "hedge_floor_s": float(os.environ.get(
+            "BLIT_FLEET_HEDGE_FLOOR", config.fleet_hedge_floor_s)),
+        "hedge_min_n": int(os.environ.get(
+            "BLIT_FLEET_HEDGE_MIN_N", config.fleet_hedge_min_n)),
+        "hot_hits": int(os.environ.get(
+            "BLIT_FLEET_HOT_HITS", config.fleet_hot_hits)),
     }
 
 

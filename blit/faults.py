@@ -33,7 +33,14 @@ module holds both halves:
   ``kill`` (SIGKILL the calling process — the unclean death a
   :class:`blit.recover.ScanSupervisor` lease detects) and ``hang``
   (sleep ``hang_s``, default far past any watchdog — the wedged-peer
-  shape that stalls collectives without dying).  Rules fire on exact hit
+  shape that stalls collectives without dying).  The fleet serve plane
+  (ISSUE 14) adds two serving-path points: ``fleet.route`` — fired by
+  the front door per peer dispatch, keyed by the peer name, so a drill
+  can delay/fail routing to one peer (forcing hedges and failover
+  without touching the peer itself) — and ``peer.request`` — fired by
+  a serving peer per handled ``/product`` request, keyed by the
+  fingerprint, so ``kill``/``hang`` drills take a REAL peer process
+  down mid-replay (the ``blit chaos --fleet`` schedule).  Rules fire on exact hit
   counts (``after``/``times``), so a test can target "window 3 of
   antenna 2" and get the same failure every run.  ``BLIT_FAULTS`` in
   the environment arms rules at import time for CLI-level drills (see

@@ -467,6 +467,12 @@ class MetricsPublisher:
         self.port: Optional[int] = None
         if port is None:
             port = d["port"]
+        elif int(port) < 0:
+            # The planes' -1 "disabled" encoding, honored for EXPLICIT
+            # ctor args too: an embedding server (the fleet PeerServer
+            # reuses this class for its health/metrics bodies) can pin
+            # the endpoint off however the environment is set.
+            port = None
         if port is not None:
             self._server = _make_http_server(self, int(port))
             self.port = self._server.server_address[1]
@@ -665,6 +671,40 @@ class MetricsPublisher:
 
     def __exit__(self, *exc):
         self.close()
+
+
+def fold_health(own_reasons: Iterable[str],
+                peer_health: Dict[str, Optional[Dict]], *,
+                clock: Callable[[], float] = time.time) -> Dict:
+    """Fold per-peer health documents into ONE fleet ``{ok, status,
+    reasons}`` answer (ISSUE 14 satellite) — the front door's
+    ``/healthz`` body, so a single probe answers "is the fleet
+    serving".
+
+    ``own_reasons`` are the door's local degradations (draining, open
+    breakers, ejected peers); ``peer_health`` maps peer name → its last
+    fetched ``/healthz`` body (None = unreachable/never fetched).  A
+    peer's own reasons fold in prefixed with its name; ``status`` is
+    ``"ok"`` only when nothing anywhere is degraded, ``"degraded"``
+    while any peer (or the door) carries a reason but the fleet can
+    still serve, and the caller may override to ``"down"`` when no
+    peers remain routable."""
+    reasons: List[str] = list(own_reasons)
+    peers_ok = 0
+    for name, doc in sorted(peer_health.items()):
+        if doc is None:
+            reasons.append(f"peer-unreachable:{name}")
+            continue
+        if doc.get("ok"):
+            peers_ok += 1
+            continue
+        peers_ok += 1  # degraded but answering — still serving
+        for r in doc.get("reasons") or ["degraded"]:
+            reasons.append(f"peer:{name}:{r}")
+    status = "ok" if not reasons else ("degraded" if peers_ok else "down")
+    return {"ok": not reasons, "status": status, "reasons": reasons,
+            "peers": len(peer_health), "peers_ok": peers_ok,
+            "t": clock()}
 
 
 # -- health hooks -----------------------------------------------------------

@@ -191,6 +191,16 @@ class _LeaseWatch:
         return self.wd.age_s()
 
 
+# Public alias (ISSUE 14): the fleet front door watches its serving
+# peers with the SAME lease discipline the scan supervisor watches pod
+# children — one staleness contract for "a process stopped making
+# progress", whatever the process serves.  Peer processes beat a
+# :class:`Lease` in the fleet's lease dir (bring-up beat + one per
+# request/heartbeat tick); the door runs a LeaseWatch per peer and
+# ejects from the consistent-hash ring on expiry.
+LeaseWatch = _LeaseWatch
+
+
 # -- planning ----------------------------------------------------------------
 
 

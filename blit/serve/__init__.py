@@ -10,6 +10,19 @@ The multi-tenant serving stack over the reduction machinery:
 - :mod:`blit.serve.service` — :class:`ProductService`, the front door:
   ``submit() -> Ticket`` / ``result()`` / ``get()``, single-flight
   request coalescing, cache-first serving.
+
+The FLEET plane (ISSUE 14) scales the same stack across hosts:
+
+- :mod:`blit.serve.ring` — :class:`HashRing`, consistent-hash routing
+  of fingerprints to owner+replica peer sets;
+- :mod:`blit.serve.http` — the stdlib-HTTP wire: :class:`PeerServer`
+  (one ProductService served over ``/product`` with lease heartbeats
+  and the monitor plane's ``/metrics``–``/healthz``) and
+  :class:`FrontDoorServer`;
+- :mod:`blit.serve.fleet` — :class:`FleetFrontDoor`: ring routing,
+  lease-driven peer ejection/rejoin, per-peer breakers, hedged reads
+  off the live p99, deadline propagation, cache-warm replication and
+  graceful drain.
 """
 
 from blit.serve.cache import (
@@ -17,13 +30,28 @@ from blit.serve.cache import (
     fingerprint_for,
     reduction_fingerprint,
 )
-from blit.serve.scheduler import Cancelled, Job, Overloaded, Scheduler
+from blit.serve.fleet import FleetError, FleetFrontDoor
+from blit.serve.http import FrontDoorServer, PeerServer
+from blit.serve.ring import HashRing
+from blit.serve.scheduler import (
+    Cancelled,
+    DeadlineExpired,
+    Job,
+    Overloaded,
+    Scheduler,
+)
 from blit.serve.service import ProductRequest, ProductService, Ticket
 
 __all__ = [
     "Cancelled",
+    "DeadlineExpired",
+    "FleetError",
+    "FleetFrontDoor",
+    "FrontDoorServer",
+    "HashRing",
     "Job",
     "Overloaded",
+    "PeerServer",
     "ProductCache",
     "ProductRequest",
     "ProductService",

@@ -156,6 +156,11 @@ class ProductCache:
             OrderedDict()
         )
         self._ram_used = 0
+        # Per-fingerprint hit totals (bounded: RAM/disk hits only, LRU
+        # pruned alongside the RAM tier) — the fleet plane's hotness
+        # signal (ISSUE 14): `hot()` feeds cache-warm replication and
+        # the drain-time hot-entry hints.
+        self._hits_by_fp: "OrderedDict[str, int]" = OrderedDict()
         self.counts: Dict[str, int] = {
             "hit.ram": 0, "hit.disk": 0, "miss": 0,
             "evict.ram": 0, "evict.disk": 0, "evict.corrupt": 0,
@@ -371,6 +376,7 @@ class ProductCache:
             if hit is not None:
                 self._ram.move_to_end(fp)
                 self.counts["hit.ram"] += 1
+                self._note_hit_locked(fp)
                 self.timeline.count("cache.hit.ram")
                 # dict() copy out: the array is frozen, but a caller
                 # mutating a by-reference header would corrupt the entry
@@ -383,6 +389,7 @@ class ProductCache:
                 with self._lock:
                     self._ram_put_locked(fp, header, data)
                     self.counts["hit.disk"] += 1
+                    self._note_hit_locked(fp)
                 self.timeline.count("cache.hit.disk")
                 return dict(header), data, "disk"
         self._count("miss")
@@ -466,6 +473,26 @@ class ProductCache:
         else:
             self._disk_evict(fp, "corrupt")
         return False
+
+    # Hotness-tracking bound: enough for any realistic hot set, small
+    # enough that the tracker can never become the memory story.
+    _HOT_TRACK_MAX = 4096
+
+    def _note_hit_locked(self, fp: str) -> None:
+        self._hits_by_fp[fp] = self._hits_by_fp.get(fp, 0) + 1
+        self._hits_by_fp.move_to_end(fp)
+        while len(self._hits_by_fp) > self._HOT_TRACK_MAX:
+            self._hits_by_fp.popitem(last=False)
+
+    def hot(self, n: int = 16) -> list:
+        """The ``n`` hottest fingerprints as ``(fp, hits)`` pairs,
+        hit-count descending (recency breaks ties) — the fleet plane's
+        cache-warm / drain-hint source (ISSUE 14)."""
+        with self._lock:
+            items = list(self._hits_by_fp.items())
+        items.reverse()  # most-recent first → stable tie-break
+        items.sort(key=lambda kv: kv[1], reverse=True)
+        return items[:max(0, int(n))]
 
     def contains(self, fp: str) -> bool:
         with self._lock:

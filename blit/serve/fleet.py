@@ -1,0 +1,694 @@
+"""Failure-domain-tolerant fleet front door (ISSUE 14 tentpole).
+
+ROADMAP item 3's missing half: `blit/serve` coalesces, caches and
+admission-controls — in ONE process.  This module puts a front door in
+front of N cache/compute peers (:class:`blit.serve.http.PeerServer`
+processes) and makes the resulting service survive its hosts:
+
+- **Consistent-hash routing** (:class:`~blit.serve.ring.HashRing`):
+  every request's PR-3 content-addressed fingerprint maps to an OWNER
+  peer plus ``replicas - 1`` successors.  Fingerprints are
+  order-insensitive over the raw members, so two doors (or one across
+  restarts) agree on ownership with no coordination and cross-host
+  dedupe is structural — identical requests, however their globs
+  ordered the members, always land on the same owner's cache, where
+  the peer's own single-flight machinery coalesces them.
+- **Failure-domain tolerance**: peer liveness is judged by heartbeat
+  leases (:class:`blit.recover.LeaseWatch` — the scan supervisor's
+  staleness discipline applied to serving peers); a silent peer is
+  EJECTED from the ring within the lease TTL and its key range
+  re-routes to the replicas, rejoining when beats resume.  Per-peer
+  :class:`~blit.faults.CircuitBreaker`\\ s fail fast on a flapping peer
+  between lease verdicts, and hot entries are CACHE-WARMED onto
+  replicas (``hot_hits`` threshold + drain-time hints), so losing the
+  owner degrades hit-rate, not correctness.
+- **Hedged reads**: when the owner has not answered within its own
+  LIVE p99 (per-peer :class:`~blit.observability.HistogramStats`, the
+  PR 5 discipline — never a guessed constant once history exists), the
+  request is duplicated to the next replica and the first answer wins.
+  At most ONE hedge per request bounds duplicate compute at 2x on the
+  hedged slice; ``fleet.hedge`` / ``fleet.hedge.win`` /
+  ``fleet.hedge.dup_done`` ride ``/metrics``.
+- **Deadline propagation**: the caller's ``deadline_s`` is checked at
+  the door before EVERY dispatch (an already-dead request never
+  reaches a peer — the acceptance pin) and travels on the wire into
+  the peer :class:`~blit.serve.scheduler.Scheduler`'s deadline-aware
+  admission and dispatch-time expiry, so no layer computes work whose
+  requester has already given up.
+- **Graceful drain**: :meth:`FleetFrontDoor.drain` refuses new
+  requests, lets in-flight ones finish, and hands the hottest
+  fingerprints' recipes to their owner/replica peers as ``/warm``
+  hints, so a door restart does not cold-start the fleet's working
+  set.
+
+The door is deliberately CACHE-LESS and QUEUE-LESS: peers own the
+two-tier caches and the admission-controlled schedulers; the door owns
+placement, liveness and retries.  That keeps its failure mode boring —
+a restarted door re-derives the whole routing state from config plus
+the lease dir in one poll interval.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import queue
+import threading
+import time
+from collections import OrderedDict
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from blit import faults
+from blit.config import DEFAULT, SiteConfig, fleet_defaults
+from blit.faults import CircuitBreaker
+from blit.observability import (
+    HistogramStats,
+    StallWatchdog,
+    Timeline,
+    flight_recorder,
+    hostname,
+    merge_fleet,
+    render_prometheus,
+)
+from blit.serve.http import (
+    decode_product,
+    http_json,
+    retry_after_from,
+    wire_request,
+)
+from blit.serve.ring import HashRing
+from blit.serve.scheduler import DeadlineExpired, Overloaded
+
+log = logging.getLogger("blit.serve.fleet")
+
+# The fleet plane's latency histograms (the MESH_HISTS convention).
+FLEET_HISTS = ("fleet.request_s", "fleet.peer_s", "fleet.detect_s")
+
+
+class FleetError(RuntimeError):
+    """Every routable replica failed (or none exist) for a request."""
+
+
+class PeerHTTPError(OSError):
+    """A peer answered outside the serve contract (HTTP 5xx that is not
+    an Overloaded/deadline mapping) — an ``OSError`` so breakers and
+    transient-retry classification treat it like a failing host."""
+
+
+class _HttpWatch:
+    """Liveness fallback when no lease dir is shared with the peers:
+    the :class:`~blit.observability.StallWatchdog` beaten by successful
+    ``/healthz`` fetches — same staleness contract, HTTP as the beat
+    transport."""
+
+    def __init__(self, name: str, ttl_s: float):
+        self.wd = StallWatchdog(ttl_s, f"blit-fleet-{name}",
+                                what="a dead peer stops answering "
+                                     "/healthz")
+        self.seen = False
+
+    def observe(self) -> None:  # the LeaseWatch poll surface
+        pass
+
+    def note_health(self, ok: bool) -> None:
+        if ok:
+            self.wd.beat()
+            self.seen = True
+
+    def stalled(self) -> bool:
+        return self.seen and self.wd.stalled()
+
+    def age_s(self) -> float:
+        return self.wd.age_s()
+
+
+class _Peer:
+    """One peer's routing state: breaker, live latency histogram,
+    lease/HTTP liveness watch, last fetched health document."""
+
+    def __init__(self, name: str, url: str, watch, *,
+                 breaker_threshold: int, breaker_cooldown_s: float):
+        self.name = name
+        self.url = url
+        self.watch = watch
+        self.breaker = CircuitBreaker(threshold=breaker_threshold,
+                                      cooldown_s=breaker_cooldown_s)
+        self.hist = HistogramStats()
+        self.in_ring = True
+        self.last_health: Optional[Dict] = None
+        self.requests = 0
+        self.failures = 0
+
+    def snapshot(self) -> Dict:
+        return {
+            "url": self.url,
+            "in_ring": self.in_ring,
+            "breaker": self.breaker.snapshot()["state"],
+            "requests": self.requests,
+            "failures": self.failures,
+            "p50_s": round(self.hist.percentile(0.50), 6),
+            "p99_s": round(self.hist.percentile(0.99), 6),
+            "n": self.hist.n,
+            "lease_age_s": round(self.watch.age_s(), 3),
+        }
+
+
+class FleetFrontDoor:
+    """The fleet's routing/liveness brain (module docstring).  Drive it
+    directly (``get()``) or serve it over HTTP with
+    :class:`blit.serve.http.FrontDoorServer`.
+
+    ``peers`` maps peer name → base URL.  ``lease_dir`` (shared with
+    the peers) switches liveness to heartbeat-lease files; without it,
+    successful ``/healthz`` fetches are the beat.  ``proc_of`` maps
+    peer name → its lease proc index (default: enumeration order).
+    ``start()`` runs the liveness loop; ``close()`` stops it."""
+
+    def __init__(self, peers: Dict[str, str], *,
+                 lease_dir: Optional[str] = None,
+                 proc_of: Optional[Dict[str, int]] = None,
+                 config: SiteConfig = DEFAULT,
+                 timeline: Optional[Timeline] = None,
+                 replicas: Optional[int] = None,
+                 peer_ttl_s: Optional[float] = None,
+                 poll_s: Optional[float] = None,
+                 health_poll_s: Optional[float] = None,
+                 hedge_floor_s: Optional[float] = None,
+                 hedge_min_n: Optional[int] = None,
+                 hot_hits: Optional[int] = None,
+                 request_timeout_s: float = 300.0,
+                 clock: Callable[[], float] = time.monotonic):
+        d = fleet_defaults(config)
+        self.replicas = int(replicas if replicas is not None
+                            else d["replicas"])
+        self.peer_ttl_s = float(peer_ttl_s if peer_ttl_s is not None
+                                else d["peer_ttl_s"])
+        self.poll_s = float(poll_s if poll_s is not None else d["poll_s"])
+        self.health_poll_s = float(
+            health_poll_s if health_poll_s is not None
+            else d["health_poll_s"])
+        self.hedge_floor_s = float(
+            hedge_floor_s if hedge_floor_s is not None
+            else d["hedge_floor_s"])
+        self.hedge_min_n = int(hedge_min_n if hedge_min_n is not None
+                               else d["hedge_min_n"])
+        self.hot_hits = int(hot_hits if hot_hits is not None
+                            else d["hot_hits"])
+        self.request_timeout_s = float(request_timeout_s)
+        self.clock = clock
+        self.timeline = timeline if timeline is not None else Timeline()
+        self.lease_dir = lease_dir
+        self.ring = HashRing(peers, vnodes=d["vnodes"],
+                             replicas=self.replicas)
+        self._peers: Dict[str, _Peer] = {}
+        for i, (name, url) in enumerate(peers.items()):
+            if lease_dir is not None:
+                from blit.recover import LeaseWatch
+
+                proc = (proc_of or {}).get(name, i)
+                watch = LeaseWatch(lease_dir, proc, self.peer_ttl_s,
+                                   grace_s=self.peer_ttl_s)
+            else:
+                watch = _HttpWatch(name, self.peer_ttl_s)
+            self._peers[name] = _Peer(
+                name, url, watch,
+                breaker_threshold=config.breaker_threshold,
+                breaker_cooldown_s=config.breaker_cooldown_s)
+        self._lock = threading.Lock()
+        self._drain_cond = threading.Condition(self._lock)
+        self._inflight = 0
+        self._draining = False
+        # Hotness: fp -> (hits, recipe), LRU-bounded — the cache-warm
+        # replication trigger and the drain-hint source.
+        self._hot: "OrderedDict[str, Tuple[int, Dict]]" = OrderedDict()
+        self._hot_max = 4096
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._last_health_fetch = 0.0
+
+    # -- liveness ----------------------------------------------------------
+    def start(self) -> "FleetFrontDoor":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._watch_loop, name="blit-fleet-watch",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def _watch_loop(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            try:
+                self.observe()
+            except Exception:  # noqa: BLE001 — liveness must not die
+                log.warning("fleet watch tick failed", exc_info=True)
+
+    def observe(self) -> None:
+        """One liveness tick (the watch loop's body; tests drive it
+        directly): observe every lease, eject stale peers, rejoin
+        recovered ones, refresh health documents on their own
+        cadence."""
+        fetch_health = False
+        now = time.monotonic()
+        if now - self._last_health_fetch >= self.health_poll_s:
+            self._last_health_fetch = now
+            fetch_health = True
+        for p in self._peers.values():
+            p.watch.observe()
+            if fetch_health:
+                self._fetch_health(p)
+            if p.in_ring and p.watch.stalled():
+                self._eject(p, f"lease stale {p.watch.age_s():.2f}s")
+            elif not p.in_ring and p.watch.seen and not p.watch.stalled():
+                self._rejoin(p)
+
+    def _fetch_health(self, p: _Peer) -> None:
+        try:
+            status, _, body = http_json("GET", p.url, "/healthz",
+                                        timeout=2.0)
+            ok = status == 200 and isinstance(body, dict)
+            p.last_health = body if ok else None
+        except OSError:
+            ok = False
+            p.last_health = None
+        if isinstance(p.watch, _HttpWatch):
+            p.watch.note_health(ok)
+
+    def _eject(self, p: _Peer, reason: str) -> None:
+        """Remove a failed peer from the ring: its key range re-routes
+        to the replica successors ON THE NEXT LOOKUP (consistent
+        hashing makes re-routing a no-op for everyone else)."""
+        if not self.ring.remove(p.name):
+            return
+        p.in_ring = False
+        self.timeline.count("fleet.eject")
+        # Detection latency (the chaos drill's budget assertion): how
+        # stale the lease was when we acted — age at detection, the
+        # recover-plane convention.
+        self.timeline.observe("fleet.detect_s", p.watch.age_s())
+        flight_recorder().event("fleet", "eject", peer=p.name,
+                                reason=reason)
+        log.warning("fleet: ejected peer %s (%s); %d peer(s) remain",
+                    p.name, reason, len(self.ring))
+
+    def _rejoin(self, p: _Peer) -> None:
+        if not self.ring.add(p.name):
+            return
+        p.in_ring = True
+        p.breaker.record_success()  # fresh start: the lease vouches
+        self.timeline.count("fleet.rejoin")
+        flight_recorder().event("fleet", "rejoin", peer=p.name)
+        log.warning("fleet: peer %s rejoined the ring", p.name)
+
+    # -- routing -----------------------------------------------------------
+    def _remaining(self, t0: float,
+                   deadline_s: Optional[float]) -> Optional[float]:
+        if deadline_s is None:
+            return None
+        return float(deadline_s) - (self.clock() - t0)
+
+    def _fetch_timeout(self, t0: float,
+                       deadline_s: Optional[float]) -> float:
+        rem = self._remaining(t0, deadline_s)
+        if rem is None:
+            return self.request_timeout_s
+        return max(0.05, min(self.request_timeout_s, rem))
+
+    def _hedge_delay(self, p: _Peer) -> float:
+        """When to try a second replica: the peer's LIVE p99 once
+        enough history exists (the PR 5 telemetry-hist discipline),
+        else the configured floor — never a guess dressed as a
+        measurement."""
+        if p.hist.n >= self.hedge_min_n:
+            return max(self.hedge_floor_s, p.hist.percentile(0.99))
+        return self.hedge_floor_s
+
+    def get(self, request, *, priority: int = 1, client: str = "anon",
+            deadline_s: Optional[float] = None
+            ) -> Tuple[Dict, np.ndarray]:
+        """Serve one product request through the fleet: route to the
+        fingerprint's owner, hedge to a replica past the live p99, fail
+        over on refusal/death, propagate the deadline every hop.
+        Raises :class:`~blit.serve.scheduler.Overloaded` /
+        :class:`~blit.serve.scheduler.DeadlineExpired` /
+        :class:`FleetError` (every replica failed)."""
+        t0 = self.clock()
+        with self._lock:
+            if self._draining:
+                self.timeline.count("fleet.rejected")
+                raise Overloaded("front door is draining; retry against "
+                                 "the replacement", retry_after_s=1.0)
+            self._inflight += 1
+        try:
+            wire = wire_request(request, priority=priority, client=client,
+                                deadline_s=deadline_s)
+            from blit.serve.cache import fingerprint_for
+
+            fp = fingerprint_for(request.reducer(), request.raw_source)
+            self.timeline.count("fleet.requests")
+            t_req = time.perf_counter()
+            header, data = self._fetch(fp, wire, t0, deadline_s)
+            self.timeline.observe("fleet.request_s",
+                                  time.perf_counter() - t_req)
+            self._note_hot(fp, wire["recipe"])
+            return header, data
+        finally:
+            with self._drain_cond:
+                self._inflight -= 1
+                self._drain_cond.notify_all()
+
+    def targets_for(self, fp: str) -> List[_Peer]:
+        return [self._peers[n] for n in self.ring.owners(fp)]
+
+    def _fetch(self, fp: str, wire: Dict, t0: float,
+               deadline_s: Optional[float]) -> Tuple[Dict, np.ndarray]:
+        targets = self.targets_for(fp)
+        if not targets:
+            raise FleetError("no live peers in the ring")
+        q: "queue.Queue" = queue.Queue()
+        done = threading.Event()
+
+        def run(p: _Peer, hedge: bool) -> None:
+            try:
+                res = self._fetch_one(p, wire, fp, t0, deadline_s)
+                ok = True
+            except BaseException as e:  # noqa: BLE001 — delivered below
+                res, ok = e, False
+            if ok and done.is_set():
+                # The duplicate finished after the winner: its work ran
+                # to completion (and warmed that peer's cache) — counted
+                # so the bench can bound duplicate compute on the
+                # hedged slice.
+                self.timeline.count("fleet.hedge.dup_done")
+            q.put((p, hedge, ok, res))
+
+        idx = 0
+        pending = 0
+
+        def launch(hedge: bool) -> Optional[_Peer]:
+            nonlocal idx, pending
+            while idx < len(targets):
+                p = targets[idx]
+                idx += 1
+                rem = self._remaining(t0, deadline_s)
+                if rem is not None and rem <= 0:
+                    return None  # the waiter raises DeadlineExpired
+                if not p.breaker.allow():
+                    self.timeline.count("fleet.skip_breaker")
+                    continue
+                if hedge:
+                    self.timeline.count("fleet.hedge")
+                pending += 1
+                threading.Thread(target=run, args=(p, hedge),
+                                 name=f"blit-fleet-{p.name}",
+                                 daemon=True).start()
+                return p
+            return None
+
+        rem = self._remaining(t0, deadline_s)
+        if rem is not None and rem <= 0:
+            # The acceptance pin: a request already dead at the front
+            # door is REJECTED here — no peer is ever dispatched.
+            self.timeline.count("fleet.deadline_expired")
+            raise DeadlineExpired(
+                f"deadline {deadline_s:.3f}s expired at the front door "
+                f"after {self.clock() - t0:.3f}s; never dispatched")
+        first = launch(hedge=False)
+        if first is None:
+            rem = self._remaining(t0, deadline_s)
+            if rem is not None and rem <= 0:
+                self.timeline.count("fleet.deadline_expired")
+                raise DeadlineExpired(
+                    f"deadline {deadline_s:.3f}s expired at the front "
+                    "door; never dispatched")
+            raise FleetError(
+                f"no routable peer for {fp[:16]}… "
+                f"({len(targets)} in ring, all breaker-blocked)")
+        hedged = False
+        last_exc: Optional[BaseException] = None
+        hedge_delay = self._hedge_delay(first)
+        while True:
+            rem = self._remaining(t0, deadline_s)
+            if not hedged and idx < len(targets):
+                wait = (hedge_delay if rem is None
+                        else min(hedge_delay, max(0.0, rem)))
+            else:
+                wait = (self.request_timeout_s if rem is None
+                        else max(0.0, rem)) + 1.0
+            try:
+                p, was_hedge, ok, res = q.get(timeout=max(0.005, wait))
+            except queue.Empty:
+                if not hedged and idx < len(targets):
+                    hedged = True
+                    launch(hedge=True)  # first-wins from here on
+                    continue
+                if rem is not None and rem <= 0:
+                    self.timeline.count("fleet.deadline_expired")
+                    raise DeadlineExpired(
+                        f"deadline {deadline_s:.3f}s expired waiting on "
+                        "replicas") from last_exc
+                raise FleetError(
+                    f"no replica answered {fp[:16]}… within "
+                    f"{self.request_timeout_s}s") from last_exc
+            pending -= 1
+            if ok:
+                done.set()
+                if was_hedge:
+                    self.timeline.count("fleet.hedge.win")
+                return res
+            last_exc = res
+            rem = self._remaining(t0, deadline_s)
+            if isinstance(res, DeadlineExpired) and (rem is None
+                                                    or rem > 0):
+                # The PEER judged the deadline unmeetable — an
+                # admission ESTIMATE over its own backlog, not a global
+                # verdict: a replica holding the cache-warmed product
+                # answers in milliseconds regardless of queue depth.
+                # Only the door's own burned budget is terminal.
+                res = Overloaded(str(res), retry_after_s=0.1)
+                last_exc = res
+            if isinstance(res, DeadlineExpired):
+                raise res  # the budget itself is gone
+            if isinstance(res, Overloaded):
+                # Alive but refusing — the breaker stays untouched;
+                # another replica may have capacity (or the cache).
+                self.timeline.count("fleet.failover")
+            else:
+                if self._record_peer_failure(p):
+                    log.warning("fleet: breaker tripped for peer %s "
+                                "(%s)", p.name, res)
+                self.timeline.count("fleet.failover")
+            nxt = launch(hedge=False)
+            if nxt is None and pending == 0:
+                if rem is not None and rem <= 0:
+                    # Out of replicas BECAUSE the budget burned during
+                    # failover: that is a deadline verdict (504, final),
+                    # not a fleet failure (500/503, retryable).
+                    self.timeline.count("fleet.deadline_expired")
+                    raise DeadlineExpired(
+                        f"deadline {deadline_s:.3f}s expired during "
+                        "failover") from last_exc
+                if isinstance(last_exc, Overloaded):
+                    raise last_exc
+                raise FleetError(
+                    f"every replica failed for {fp[:16]}…: "
+                    f"{last_exc}") from last_exc
+
+    def _record_peer_failure(self, p: _Peer) -> bool:
+        p.failures += 1
+        tripped = p.breaker.record_failure()
+        if tripped:
+            self.timeline.count("fleet.breaker_trip")
+            flight_recorder().event("fleet", "breaker_trip", peer=p.name)
+        return tripped
+
+    def _fetch_one(self, p: _Peer, wire: Dict, fp: str, t0: float,
+                   deadline_s: Optional[float]
+                   ) -> Tuple[Dict, np.ndarray]:
+        """One peer round-trip, with the remaining deadline propagated
+        ON THE WIRE (the peer's scheduler re-checks it at admission and
+        dispatch) and the live latency histogram fed either way."""
+        faults.fire("fleet.route", key=p.name)
+        doc = dict(wire)
+        rem = self._remaining(t0, deadline_s)
+        if rem is not None:
+            doc["deadline_s"] = max(0.0, rem)
+        p.requests += 1
+        self.timeline.count("fleet.route")
+        t = time.perf_counter()
+        try:
+            status, hdrs, body = http_json(
+                "POST", p.url, "/product", doc,
+                timeout=self._fetch_timeout(t0, deadline_s))
+        finally:
+            dt = time.perf_counter() - t
+            p.hist.observe(dt)
+            self.timeline.observe("fleet.peer_s", dt)
+        if status == 200:
+            p.breaker.record_success()
+            return decode_product(body)
+        msg = (body.get("error") if isinstance(body, dict)
+               else str(body)[:200])
+        if status == 503:
+            raise Overloaded(f"peer {p.name}: {msg}",
+                             retry_after_s=retry_after_from(hdrs, body))
+        if status == 504:
+            raise DeadlineExpired(f"peer {p.name}: {msg}")
+        raise PeerHTTPError(f"peer {p.name} answered HTTP {status}: {msg}")
+
+    # -- cache-warm replication --------------------------------------------
+    def _note_hot(self, fp: str, recipe: Dict) -> None:
+        with self._lock:
+            hits, _ = self._hot.get(fp, (0, None))
+            hits += 1
+            self._hot[fp] = (hits, recipe)
+            self._hot.move_to_end(fp)
+            while len(self._hot) > self._hot_max:
+                self._hot.popitem(last=False)
+        if hits != self.hot_hits:
+            return
+        # Crossing the hotness threshold: warm the REPLICAS now, so
+        # losing the owner later degrades hit-rate, not correctness —
+        # and the degradation recovers from a warm disk tier, not a
+        # recompute storm.
+        replicas = self.ring.owners(fp)[1:]
+        if replicas:
+            self.timeline.count("fleet.warm")
+            threading.Thread(
+                target=self._send_warm,
+                args=([self._peers[n] for n in replicas], [recipe]),
+                name="blit-fleet-warm", daemon=True).start()
+
+    def _send_warm(self, peers: List[_Peer], recipes: List[Dict]) -> None:
+        for p in peers:
+            try:
+                http_json("POST", p.url, "/warm", {"recipes": recipes},
+                          timeout=5.0)
+            except OSError:
+                pass  # warming is best-effort by definition
+
+    # -- surfaces ----------------------------------------------------------
+    def health(self) -> Dict:
+        """The aggregated fleet ``/healthz`` (ISSUE 14 satellite): one
+        probe answers "is the fleet serving" — the door's own state
+        (draining, ejections, breakers) folded with every peer's last
+        health document via :func:`blit.monitor.fold_health`."""
+        from blit.monitor import fold_health
+
+        own: List[str] = []
+        with self._lock:
+            if self._draining:
+                own.append("draining")
+        peer_health: Dict[str, Optional[Dict]] = {}
+        for name, p in sorted(self._peers.items()):
+            if not p.in_ring:
+                own.append(f"peer-ejected:{name}")
+                continue
+            state = p.breaker.snapshot()["state"]
+            if state != "closed":
+                own.append(f"breaker-{state.replace('-', '_')}:{name}")
+            peer_health[name] = p.last_health
+        doc = fold_health(own, peer_health)
+        doc["ring"] = self.ring.peers()
+        doc["peers_total"] = len(self._peers)
+        if not len(self.ring):
+            doc["ok"] = False
+            doc["status"] = "down"
+        return doc
+
+    def stats(self) -> Dict:
+        with self._lock:
+            hot = sorted(((fp, h) for fp, (h, _) in self._hot.items()),
+                         key=lambda kv: kv[1], reverse=True)[:8]
+            inflight = self._inflight
+        rep = self.timeline.report()
+        counters = {k: row["calls"] for k, row in rep.items()
+                    if k.startswith("fleet.") and isinstance(row, dict)
+                    and "calls" in row}
+        return {
+            "peers": {n: p.snapshot()
+                      for n, p in sorted(self._peers.items())},
+            "ring": self.ring.peers(),
+            "replicas": self.replicas,
+            "inflight": inflight,
+            "draining": self._draining,
+            "hot": [[fp[:16], h] for fp, h in hot],
+            "counters": counters,
+            "hists": {k: v for k, v in (rep.get("hists") or {}).items()
+                      if k in FLEET_HISTS},
+        }
+
+    def metrics_prometheus(self) -> str:
+        snapshot = {"host": hostname(), "pid": os.getpid(), "worker": 0,
+                    "timeline": self.timeline.state(),
+                    "faults": faults.counters(), "spans": []}
+        return render_prometheus(merge_fleet([snapshot]))
+
+    # -- drain / teardown --------------------------------------------------
+    def drain(self, timeout: Optional[float] = 30.0,
+              hints: int = 32) -> Dict[str, int]:
+        """Graceful front-door shutdown (tentpole #5): refuse new
+        requests NOW, wait for in-flight ones to finish, then hand the
+        ``hints`` hottest fingerprints' recipes to their current
+        owner+replica peers as ``/warm`` hints — the door's working-set
+        knowledge outlives the door."""
+        with self._drain_cond:
+            self._draining = True
+            deadline = (None if timeout is None
+                        else time.monotonic() + timeout)
+            while self._inflight > 0:
+                if deadline is not None and time.monotonic() >= deadline:
+                    log.warning("fleet drain timed out with %d in-flight",
+                                self._inflight)
+                    break
+                self._drain_cond.wait(timeout=0.1)
+            hottest = sorted(self._hot.items(), key=lambda kv: kv[1][0],
+                             reverse=True)[:max(0, int(hints))]
+        per_peer: Dict[str, List[Dict]] = {}
+        for fp, (_, recipe) in hottest:
+            if recipe is None:
+                continue
+            for name in self.ring.owners(fp):
+                per_peer.setdefault(name, []).append(recipe)
+        sent = 0
+        for name, recipes in per_peer.items():
+            try:
+                http_json("POST", self._peers[name].url, "/warm",
+                          {"recipes": recipes}, timeout=5.0)
+                sent += len(recipes)
+            except OSError:
+                pass
+        self.timeline.count("fleet.drain.hints", sent)
+        log.info("fleet drain: %d hot-entry hints handed to %d peer(s)",
+                 sent, len(per_peer))
+        return {"hints": sent, "peers_hinted": len(per_peer)}
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def peers_from_spec(spec: Iterable[str]) -> Dict[str, str]:
+    """Parse ``name=url`` (or bare ``url`` → ``peer<i>``) peer specs —
+    the CLI's ``--peer`` flag grammar."""
+    out: Dict[str, str] = {}
+    for i, s in enumerate(spec):
+        if "=" in s:
+            name, url = s.split("=", 1)
+        else:
+            name, url = f"peer{i}", s
+        out[name] = url.rstrip("/")
+    return out
+
+
+__all__ = ["FLEET_HISTS", "FleetError", "FleetFrontDoor",
+           "PeerHTTPError", "peers_from_spec"]

@@ -1,0 +1,567 @@
+"""Stdlib-HTTP plumbing for the fleet serve plane (ISSUE 14 tentpole).
+
+One wire, three speakers:
+
+- **codecs** — :func:`wire_request` / :func:`request_from_wire` carry a
+  :class:`~blit.serve.service.ProductRequest` as its JSON recipe (the
+  ISSUE 13 re-derivation recipe made transport), and
+  :func:`encode_product` / :func:`decode_product` carry the finished
+  ``(header, array)`` product as JSON + base64 payload bytes — small
+  products by design (the serve layer returns reduced arrays, not raw
+  voltages), so JSON keeps every hop debuggable with ``curl``.
+- :class:`PeerServer` — one serving peer: a
+  :class:`~blit.serve.service.ProductService` behind ``POST /product``
+  (+ ``/warm`` cache-warm hints, ``/stats``, ``POST /drain``), with the
+  ``/metrics``–``/healthz`` surface REUSED from
+  :class:`blit.monitor.MetricsPublisher` (same Prometheus exposition,
+  same honest-degradation health document) and a heartbeat
+  :class:`blit.recover.Lease` beaten on a background thread so the
+  front door detects a dead/wedged peer within the lease TTL — the
+  recover-plane staleness contract applied to serving.
+- :class:`FrontDoorServer` — the fleet front door
+  (:class:`blit.serve.fleet.FleetFrontDoor`) as an HTTP service with
+  the same ``/product`` shape, an AGGREGATED ``/healthz``
+  (:func:`blit.monitor.fold_health`), and ``/metrics`` for the routing
+  counters (hedges, failovers, ejections).
+
+Error mapping, both servers: :class:`~blit.serve.scheduler.Overloaded`
+→ **503** with the seeded-jitter ``retry_after_s`` honored as the
+``Retry-After`` header (the thundering-herd satellite);
+:class:`~blit.serve.scheduler.DeadlineExpired` → **504** (the request
+was never computed); anything else → **500** carrying the error type.
+
+:func:`install_drain_handler` wires SIGTERM/SIGINT to a graceful drain
+(refuse new, finish in-flight, release ``kind="stream"`` holds) — used
+by ``blit fleet-peer`` and ``blit serve-bench`` so an interpreter exit
+stops leaking capacity holds (ISSUE 14 satellite).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import logging
+import threading
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from blit import faults
+from blit.serve.scheduler import DeadlineExpired, Overloaded
+
+log = logging.getLogger("blit.serve.http")
+
+
+# -- wire codecs -------------------------------------------------------------
+
+
+def encode_product(header: Dict, data: np.ndarray) -> Dict:
+    """The JSON wire form of a finished product: header + shape/dtype +
+    base64 payload bytes (C-order)."""
+    arr = np.ascontiguousarray(data)
+    return {
+        "header": {k: (v.item() if isinstance(v, np.generic) else v)
+                   for k, v in dict(header).items()},
+        "shape": list(arr.shape),
+        "dtype": str(arr.dtype),
+        "data_b64": base64.b64encode(arr.tobytes()).decode("ascii"),
+    }
+
+
+def decode_product(doc: Dict) -> Tuple[Dict, np.ndarray]:
+    """Inverse of :func:`encode_product` — the array comes back
+    READ-ONLY (``np.frombuffer`` of immutable bytes), matching the
+    cache's frozen-result contract."""
+    raw = base64.b64decode(doc["data_b64"])
+    arr = np.frombuffer(raw, dtype=np.dtype(doc["dtype"]))
+    arr = arr.reshape(tuple(doc["shape"]))
+    return dict(doc["header"]), arr
+
+
+def wire_request(request, *, priority: int = 1, client: str = "anon",
+                 deadline_s: Optional[float] = None) -> Dict:
+    """A :class:`~blit.serve.service.ProductRequest` as one wire
+    document.  Live sessions (``kind="stream"``) are refused: a session
+    is pinned to ONE host for its recording's duration — it has no
+    meaningful ring owner, no replica, and no cacheable result, so the
+    fleet plane serves bounded products only."""
+    if request.kind == "stream":
+        raise ValueError(
+            "kind='stream' live sessions do not ride the fleet wire — "
+            "submit them to one peer's ProductService directly")
+    return {"recipe": request.recipe(), "priority": int(priority),
+            "client": str(client),
+            "deadline_s": (None if deadline_s is None
+                           else float(deadline_s))}
+
+
+def request_from_wire(doc: Dict):
+    """``(ProductRequest, priority, client, deadline_s)`` from a wire
+    document (unknown recipe keys ignored — the
+    :meth:`ProductRequest.from_recipe` forward-compat rule)."""
+    from blit.serve.service import ProductRequest
+
+    req = ProductRequest.from_recipe(doc["recipe"])
+    return (req, int(doc.get("priority", 1)),
+            str(doc.get("client", "anon")), doc.get("deadline_s"))
+
+
+# -- tiny HTTP client --------------------------------------------------------
+
+
+def http_json(method: str, url: str, path: str, doc: Optional[Dict] = None,
+              timeout: float = 10.0) -> Tuple[int, Dict[str, str], object]:
+    """One JSON request to ``url`` (``http://host:port``) →
+    ``(status, headers, parsed body)`` — body is the parsed JSON when
+    the response says so, else the raw text (``/metrics``).  Raises
+    ``OSError`` on transport failure (refused/reset/timeout), which the
+    front door classifies as a peer failure."""
+    import http.client
+    from urllib.parse import urlsplit
+
+    parts = urlsplit(url)
+    conn = http.client.HTTPConnection(parts.hostname,
+                                      parts.port or 80, timeout=timeout)
+    try:
+        body = None
+        headers = {}
+        if doc is not None:
+            body = json.dumps(doc).encode()
+            headers["Content-Type"] = "application/json"
+        conn.request(method, path, body=body, headers=headers)
+        resp = conn.getresponse()
+        payload = resp.read()
+        hdrs = {k.lower(): v for k, v in resp.getheaders()}
+        if "json" in (hdrs.get("content-type") or ""):
+            try:
+                return resp.status, hdrs, json.loads(payload or b"{}")
+            except ValueError:
+                pass
+        return resp.status, hdrs, payload.decode("utf-8", "replace")
+    finally:
+        conn.close()
+
+
+# -- shared server skeleton --------------------------------------------------
+
+
+def _make_server(router: Callable, port: int, host: str = "127.0.0.1"):
+    """A ThreadingHTTPServer whose GET/POST route through ``router``:
+    ``router(method, path, doc) -> (status, body, ctype, headers)`` —
+    the :func:`blit.monitor._make_http_server` shape, generalized so the
+    peer and the front door share one handler.  ``host`` defaults to
+    loopback (safe local default); a multi-host fleet binds
+    ``"0.0.0.0"`` (``blit fleet-peer --host``)."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def _route(self, method: str):
+            try:
+                doc = None
+                n = int(self.headers.get("Content-Length") or 0)
+                if n:
+                    try:
+                        doc = json.loads(self.rfile.read(n))
+                    except ValueError:
+                        self.send_error(400, "unparseable JSON body")
+                        return
+                status, body, ctype, extra = router(method, self.path, doc)
+            except Exception as e:  # noqa: BLE001 — a request must not kill
+                log.warning("http route failed", exc_info=True)
+                status, body, ctype, extra = (
+                    500, json.dumps({"error": str(e),
+                                     "etype": type(e).__name__}),
+                    "application/json", {})
+            blob = body.encode() if isinstance(body, str) else body
+            self.send_response(status)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(blob)))
+            for k, v in (extra or {}).items():
+                self.send_header(k, str(v))
+            self.end_headers()
+            self.wfile.write(blob)
+
+        def do_GET(self):  # noqa: N802 — stdlib contract
+            self._route("GET")
+
+        def do_POST(self):  # noqa: N802 — stdlib contract
+            self._route("POST")
+
+        def log_message(self, fmt, *args):  # quiet request traffic
+            log.debug("http: " + fmt, *args)
+
+    server = ThreadingHTTPServer((host, int(port)), Handler)
+    server.daemon_threads = True
+    return server
+
+
+def _json_resp(status: int, doc: Dict,
+               headers: Optional[Dict] = None) -> Tuple:
+    return status, json.dumps(doc), "application/json", headers or {}
+
+
+def _error_resp(e: BaseException) -> Tuple:
+    """The shared serve-error → HTTP mapping (module docstring)."""
+    if isinstance(e, DeadlineExpired):
+        return _json_resp(504, {"error": str(e), "etype": "DeadlineExpired",
+                                "retry_after_s": e.retry_after_s})
+    if isinstance(e, Overloaded):
+        # The jittered back-off hint honored ON THE WIRE (ISSUE 14
+        # satellite): every rejected client reads a DIFFERENT
+        # Retry-After, so the herd does not return in one instant.
+        ra = max(0.0, float(e.retry_after_s))
+        return _json_resp(503, {"error": str(e), "etype": "Overloaded",
+                                "retry_after_s": ra},
+                          {"Retry-After": f"{ra:.3f}"})
+    return _json_resp(500, {"error": str(e), "etype": type(e).__name__})
+
+
+# -- the serving peer --------------------------------------------------------
+
+
+class PeerServer:
+    """One cache/compute peer of the fleet (module docstring): a
+    :class:`~blit.serve.service.ProductService` served over HTTP, with
+    lease heartbeats and the monitor plane's ``/metrics``–``/healthz``
+    surface.  ``port=0`` binds an ephemeral port (``.port`` / ``.url``
+    say which).  ``lease_dir``/``proc`` arm the heartbeat lease the
+    front door watches; ``beat_interval_s`` should sit well under the
+    fleet's ``peer_ttl_s`` (default: 0.5 s).
+
+    The server owns its HTTP lifecycle but NOT the service: ``close()``
+    stops serving and beating; draining/closing the service stays the
+    caller's call (``blit fleet-peer`` wires SIGTERM → :meth:`drain` →
+    exit)."""
+
+    def __init__(self, service, *, name: str = "peer", port: int = 0,
+                 host: str = "127.0.0.1",
+                 lease_dir: Optional[str] = None, proc: int = 0,
+                 beat_interval_s: float = 0.5,
+                 request_timeout_s: float = 300.0):
+        self.service = service
+        self.name = name
+        self.request_timeout_s = float(request_timeout_s)
+        # The monitor plane's surface, reused wholesale: health() folds
+        # breakers/recover-hooks/SLO burn; fleet_report() renders the
+        # service timeline as native-histogram Prometheus exposition.
+        from blit.monitor import MetricsPublisher
+
+        # port=-1 / spool_dir="": explicitly OFF — this server IS the
+        # peer's endpoint; the publisher only renders its bodies.
+        self._pub = MetricsPublisher(interval_s=3600.0, spool_dir="",
+                                     port=-1, timeline=service.timeline)
+        self._server = _make_server(self._route, port, host)
+        self.port = self._server.server_address[1]
+        # The advertised URL: loopback when bound there, else the
+        # wildcard bind resolves to this host's name for the peers map.
+        adv = "127.0.0.1" if host in ("127.0.0.1", "localhost") else host
+        self.url = f"http://{adv}:{self.port}"
+        self._server_thread: Optional[threading.Thread] = None
+        self._lease = None
+        self._beat_stop = threading.Event()
+        self._beat_thread: Optional[threading.Thread] = None
+        if lease_dir is not None:
+            from blit.recover import Lease
+
+            self._lease = Lease(lease_dir, proc)
+            self._beat_interval_s = max(0.05, float(beat_interval_s))
+        self.counts: Dict[str, int] = {"product": 0, "warm": 0}
+        self._counts_lock = threading.Lock()
+
+    # -- routing -----------------------------------------------------------
+    def _route(self, method: str, path: str, doc: Optional[Dict]) -> Tuple:
+        if method == "GET" and path.startswith("/healthz"):
+            return _json_resp(200, self.health())
+        if method == "GET" and path.startswith("/metrics"):
+            from blit.observability import render_prometheus
+
+            return (200, render_prometheus(self._pub.fleet_report()),
+                    "text/plain; version=0.0.4", {})
+        if method == "GET" and path.startswith("/stats"):
+            return _json_resp(200, self.stats())
+        if method == "POST" and path.startswith("/product"):
+            return self._handle_product(doc or {})
+        if method == "POST" and path.startswith("/warm"):
+            return self._handle_warm(doc or {})
+        if method == "POST" and path.startswith("/drain"):
+            threading.Thread(target=self.drain, name=f"{self.name}-drain",
+                             daemon=True).start()
+            return _json_resp(200, {"draining": True})
+        return _json_resp(404, {"error": f"no route {method} {path}"})
+
+    def _handle_product(self, doc: Dict) -> Tuple:
+        with self._counts_lock:
+            self.counts["product"] += 1
+        try:
+            req, priority, client, deadline_s = request_from_wire(doc)
+            # The chaos schedule's injection point: kill/hang/delay THIS
+            # peer on the Nth handled request (blit chaos --fleet).
+            faults.fire("peer.request", key=str(req.raw_source))
+            timeout = (min(self.request_timeout_s, deadline_s)
+                       if deadline_s is not None else self.request_timeout_s)
+            try:
+                header, data = self.service.get(
+                    req, timeout=timeout, priority=priority, client=client,
+                    deadline_s=deadline_s)
+            except TimeoutError as e:
+                if deadline_s is None:
+                    raise
+                # The reduction ran PAST the caller's deadline (the
+                # admission estimate under-predicted): that is a
+                # deadline verdict — 504, which the front door treats
+                # as breaker-NEUTRAL — not a peer failure that should
+                # trip a healthy host's breaker.
+                raise DeadlineExpired(
+                    f"deadline {deadline_s:.3f}s expired mid-compute: "
+                    f"{e}") from e
+        except BaseException as e:  # noqa: BLE001 — mapped onto the wire
+            return _error_resp(e)
+        return _json_resp(200, encode_product(header, data))
+
+    def _handle_warm(self, doc: Dict) -> Tuple:
+        """Cache-warm hints (ISSUE 14): submit each recipe at the
+        lowest priority, fire-and-forget — a warm failure is a cold
+        cache, never an error.  The peer's own cache/single-flight
+        machinery dedupes repeats."""
+        accepted = rejected = 0
+        from blit.serve.service import ProductRequest
+
+        for recipe in (doc.get("recipes") or []):
+            with self._counts_lock:
+                self.counts["warm"] += 1
+            try:
+                self.service.submit(ProductRequest.from_recipe(recipe),
+                                    priority=9, client="fleet-warm")
+                accepted += 1
+            except Exception:  # noqa: BLE001 — warming is best-effort
+                rejected += 1
+        self.service.timeline.count("serve.warm", accepted)
+        return _json_resp(202, {"accepted": accepted,
+                                "rejected": rejected})
+
+    # -- surfaces ----------------------------------------------------------
+    def health(self) -> Dict:
+        """The peer's ``/healthz`` body: the monitor plane's honest
+        document, degraded further while this peer drains."""
+        doc = self._pub.health()
+        if self.service.draining():
+            doc["reasons"] = list(doc.get("reasons") or []) + ["draining"]
+            doc["ok"] = False
+            doc["status"] = "degraded"
+        doc["name"] = self.name
+        return doc
+
+    def stats(self) -> Dict:
+        s = self.service.stats()
+        s["name"] = self.name
+        s["hot"] = self.service.cache.hot(8)
+        with self._counts_lock:
+            s["http"] = dict(self.counts)
+        return s
+
+    # -- lifecycle ---------------------------------------------------------
+    def _beat_loop(self) -> None:
+        while not self._beat_stop.wait(self._beat_interval_s):
+            try:
+                self._lease.beat()
+            except OSError:
+                log.warning("peer lease beat failed", exc_info=True)
+
+    def start(self) -> "PeerServer":
+        if self._server_thread is None:
+            self._server_thread = threading.Thread(
+                target=self._server.serve_forever,
+                name=f"blit-peer-{self.name}", daemon=True)
+            self._server_thread.start()
+        if self._lease is not None and self._beat_thread is None:
+            self._lease.beat()  # bring-up beat: alive before first tick
+            self._beat_thread = threading.Thread(
+                target=self._beat_loop, name=f"blit-peer-{self.name}-beat",
+                daemon=True)
+            self._beat_thread.start()
+        return self
+
+    def drain(self, timeout: Optional[float] = 30.0) -> Dict[str, int]:
+        """Graceful drain: the service refuses new work and finishes
+        in-flight (releasing live-session holds); the lease KEEPS
+        beating and ``/healthz`` answers degraded-draining, so the
+        front door routes around an announced shutdown instead of
+        burning its lease TTL discovering it."""
+        return self.service.drain(timeout)
+
+    def close(self) -> None:
+        self._beat_stop.set()
+        if self._beat_thread is not None:
+            self._beat_thread.join(timeout=2.0)
+            self._beat_thread = None
+        self._server.shutdown()
+        self._server.server_close()
+        self._server_thread = None
+        self._pub.close()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+# -- the front door as HTTP --------------------------------------------------
+
+
+class FrontDoorServer:
+    """The fleet front door (:class:`blit.serve.fleet.FleetFrontDoor`)
+    served over HTTP: same ``/product`` wire as a peer (clients cannot
+    tell one host from a fleet), aggregated ``/healthz``
+    (:func:`blit.monitor.fold_health` — one probe answers "is the fleet
+    serving"), ``/metrics`` with the routing counters, ``/stats``, and
+    ``POST /drain``."""
+
+    def __init__(self, door, *, port: int = 0, host: str = "127.0.0.1"):
+        self.door = door
+        self._server = _make_server(self._route, port, host)
+        self.port = self._server.server_address[1]
+        adv = "127.0.0.1" if host in ("127.0.0.1", "localhost") else host
+        self.url = f"http://{adv}:{self.port}"
+        self._server_thread: Optional[threading.Thread] = None
+
+    def _route(self, method: str, path: str, doc: Optional[Dict]) -> Tuple:
+        if method == "GET" and path.startswith("/healthz"):
+            return _json_resp(200, self.door.health())
+        if method == "GET" and path.startswith("/metrics"):
+            return (200, self.door.metrics_prometheus(),
+                    "text/plain; version=0.0.4", {})
+        if method == "GET" and path.startswith("/stats"):
+            return _json_resp(200, self.door.stats())
+        if method == "POST" and path.startswith("/product"):
+            try:
+                req, priority, client, deadline_s = request_from_wire(
+                    doc or {})
+                header, data = self.door.get(
+                    req, priority=priority, client=client,
+                    deadline_s=deadline_s)
+            except BaseException as e:  # noqa: BLE001 — mapped
+                return _error_resp(e)
+            return _json_resp(200, encode_product(header, data))
+        if method == "POST" and path.startswith("/drain"):
+            threading.Thread(target=self.door.drain,
+                             name="blit-door-drain", daemon=True).start()
+            return _json_resp(200, {"draining": True})
+        return _json_resp(404, {"error": f"no route {method} {path}"})
+
+    def start(self) -> "FrontDoorServer":
+        if self._server_thread is None:
+            self._server_thread = threading.Thread(
+                target=self._server.serve_forever, name="blit-front-door",
+                daemon=True)
+            self._server_thread.start()
+        return self
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        self._server_thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+# -- signal wiring -----------------------------------------------------------
+
+
+def install_drain_handler(drain_fn: Callable[[], object], *,
+                          exit_after: bool = True,
+                          signals: Optional[Tuple] = None):
+    """Wire SIGTERM/SIGINT to a graceful drain (ISSUE 14 satellite):
+    the FIRST signal runs ``drain_fn`` (refuse new, finish in-flight,
+    release ``kind="stream"`` holds) and then — with ``exit_after`` —
+    raises ``SystemExit(128+signum)``; a SECOND signal while draining
+    exits immediately (the operator's escalation path).  Returns an
+    uninstall callable restoring the previous handlers.  No-ops (and
+    returns a no-op) off the main thread, where CPython forbids signal
+    installation."""
+    import signal as _signal
+
+    if signals is None:
+        signals = (_signal.SIGTERM, _signal.SIGINT)
+    prev = {}
+    state = {"fired": False}
+
+    def _handler(signum, frame):
+        if state["fired"]:
+            raise SystemExit(128 + signum)
+        state["fired"] = True
+        log.warning("signal %s: draining (second signal exits now)",
+                    signum)
+        try:
+            drain_fn()
+        finally:
+            if exit_after:
+                raise SystemExit(128 + signum)
+
+    for s in signals:
+        try:
+            prev[s] = _signal.signal(s, _handler)
+        except (ValueError, OSError):  # not the main thread
+            pass
+
+    def uninstall():
+        for s, h in prev.items():
+            try:
+                _signal.signal(s, h)
+            except (ValueError, OSError):
+                pass
+
+    return uninstall
+
+
+# -- wait helpers (bench/chaos bring-up) -------------------------------------
+
+
+def wait_http_ready(url: str, path: str = "/healthz",
+                    timeout_s: float = 30.0,
+                    poll_s: float = 0.05) -> Dict:
+    """Poll ``url+path`` until it answers 200 (→ the parsed body) or
+    the budget burns (``TimeoutError``) — the bench/chaos bring-up
+    barrier for peer subprocesses."""
+    deadline = time.monotonic() + timeout_s
+    last: Optional[str] = None
+    while time.monotonic() < deadline:
+        try:
+            status, _, body = http_json("GET", url, path, timeout=2.0)
+            if status == 200:
+                return body if isinstance(body, dict) else {}
+            last = f"HTTP {status}"
+        except OSError as e:
+            last = str(e)
+        time.sleep(poll_s)
+    raise TimeoutError(f"{url}{path} not ready in {timeout_s}s ({last})")
+
+
+def retry_after_from(headers: Dict[str, str], body: object) -> float:
+    """The jittered back-off a 503 told us to honor: the JSON body's
+    exact float when present, else the ``Retry-After`` header."""
+    if isinstance(body, dict) and "retry_after_s" in body:
+        return float(body["retry_after_s"])
+    try:
+        return float(headers.get("retry-after", 1.0))
+    except ValueError:
+        return 1.0
+
+
+__all__ = [
+    "FrontDoorServer",
+    "PeerServer",
+    "decode_product",
+    "encode_product",
+    "http_json",
+    "install_drain_handler",
+    "request_from_wire",
+    "retry_after_from",
+    "wait_http_ready",
+    "wire_request",
+]

@@ -36,6 +36,7 @@ pool's thread backend).  ``clock`` is injectable so tests steer time.
 from __future__ import annotations
 
 import logging
+import random
 import threading
 import time
 from collections import deque
@@ -49,11 +50,27 @@ log = logging.getLogger("blit.serve.sched")
 
 class Overloaded(RuntimeError):
     """Admission refused: queue full or deadline unmeetable.  Callers
-    should back off at least ``retry_after_s`` before resubmitting."""
+    should back off at least ``retry_after_s`` before resubmitting.
+
+    ``retry_after_s`` carries seeded JITTER (ISSUE 14 satellite): the
+    raw estimate is deterministic, so a burst of simultaneously rejected
+    clients obeying it verbatim would all come back in the same instant
+    — the thundering herd the rejection was shedding.  The scheduler
+    spreads them with the :class:`blit.faults.RetryPolicy` jitter
+    discipline (uniform in ``est * (1 ± jitter)``, a pure function of
+    ``(seed, rejection index)`` when seeded), and the HTTP front door
+    honors the jittered value as the 503 ``Retry-After`` header."""
 
     def __init__(self, message: str, retry_after_s: float = 1.0):
         super().__init__(message)
         self.retry_after_s = retry_after_s
+
+
+class DeadlineExpired(Overloaded):
+    """The job's deadline burned before it could run (rejected at
+    admission, or dropped at dispatch time after queueing past it) —
+    the work was never computed.  An :class:`Overloaded` subclass so
+    existing back-off handling applies."""
 
 
 class Cancelled(RuntimeError):
@@ -66,12 +83,19 @@ class Job:
 
     __slots__ = ("fn", "priority", "client", "deadline_s", "submitted_at",
                  "started_at", "finished_at", "state", "_result", "_exc",
-                 "_done", "held")
+                 "_done", "held", "on_drop")
 
     def __init__(self, fn: Callable[[], object], priority: int, client: str,
                  deadline_s: Optional[float], now: float,
-                 held: bool = False):
+                 held: bool = False,
+                 on_drop: Optional[Callable[[BaseException], None]] = None):
         self.fn = fn
+        # Called (on its own thread, like fn would have been) when the
+        # scheduler DROPS the job without running it — dispatch-time
+        # deadline expiry.  The service layer uses it to fail the
+        # single-flight group, so waiters and later coalescers are not
+        # left hanging on a job whose fn never ran (ISSUE 14 review).
+        self.on_drop = on_drop
         self.priority = priority
         self.client = client
         self.deadline_s = deadline_s
@@ -125,9 +149,20 @@ class Scheduler:
         timeline: Optional[Timeline] = None,
         clock: Callable[[], float] = time.monotonic,
         wait_est_floor: int = 32,
+        retry_jitter: float = 0.5,
+        retry_seed: Optional[int] = None,
     ):
         self.max_concurrency = max(1, int(max_concurrency))
         self.queue_depth = max(1, int(queue_depth))
+        # Thundering-herd spread on rejection (ISSUE 14 satellite): the
+        # RetryPolicy jitter discipline applied to retry_after_s.  With
+        # retry_seed set, rejection k's jitter is a pure function of
+        # (seed, k) — deterministic across runs, different across
+        # rejections, so a drill replays the exact same spread.
+        self.retry_jitter = max(0.0, float(retry_jitter))
+        self.retry_seed = retry_seed
+        self._reject_seq = 0
+        self._retry_lock = threading.Lock()
         # Admission estimator regime switch (ISSUE 11 satellite; the
         # ROADMAP item-3 carve-out): below this many recorded waits the
         # EWMA model estimates, at/above it the REAL wait_hist p99 does.
@@ -277,6 +312,23 @@ class Scheduler:
             return p99
         return (ahead * svc) / budget_free
 
+    def _retry_after_s(self, est: float) -> float:
+        """The jittered ``retry_after_s`` for one rejection: the
+        deterministic estimate spread by the RetryPolicy jitter rule
+        (``est * (1 ± jitter)``) so simultaneously rejected clients do
+        not return simultaneously.  Its own tiny lock, not the
+        scheduler's: the service layer calls this for its own refusals
+        (draining) while the scheduler lock may be held elsewhere."""
+        base = max(0.1, est)
+        if not self.retry_jitter:
+            return base
+        with self._retry_lock:
+            k = self._reject_seq
+            self._reject_seq += 1
+        u = (random.Random(self.retry_seed * 1_000_003 + k).random()
+             if self.retry_seed is not None else random.random())
+        return max(0.05, base * (1.0 + self.retry_jitter * (2.0 * u - 1.0)))
+
     # -- submission --------------------------------------------------------
     def submit(
         self,
@@ -286,6 +338,7 @@ class Scheduler:
         client: str = "anon",
         deadline_s: Optional[float] = None,
         hold: bool = False,
+        on_drop: Optional[Callable[[BaseException], None]] = None,
     ) -> Job:
         """Admit ``fn`` for execution, or raise :class:`Overloaded`.
 
@@ -314,16 +367,18 @@ class Scheduler:
                 raise Overloaded(
                     f"priority-{priority} queue full "
                     f"({depth_cap} jobs{shed}); try later",
-                    retry_after_s=max(0.1, est),
+                    retry_after_s=self._retry_after_s(est),
                 )
             if deadline_s is not None and est > deadline_s:
                 self.counts["rejected"] += 1
                 self.timeline.count("sched.rejected")
-                raise Overloaded(
+                raise DeadlineExpired(
                     f"deadline {deadline_s:.3f}s unmeetable: estimated "
-                    f"queue wait {est:.3f}s", retry_after_s=max(0.1, est),
+                    f"queue wait {est:.3f}s",
+                    retry_after_s=self._retry_after_s(est),
                 )
-            job = Job(fn, priority, client, deadline_s, now, held=hold)
+            job = Job(fn, priority, client, deadline_s, now, held=hold,
+                      on_drop=on_drop)
             per_client = self._queues.setdefault(priority, {})
             q = per_client.get(client)
             if q is None:
@@ -372,6 +427,33 @@ class Scheduler:
             job = self._pop_next_locked()
             if job is None:
                 return
+            if (job.deadline_s is not None
+                    and self.clock() - job.submitted_at > job.deadline_s):
+                # The deadline burned while the job sat queued (ISSUE 14
+                # acceptance): an already-dead request is NEVER computed
+                # — it is failed here, at dispatch, without a slot or
+                # (fleet path) a peer ever touching it.
+                if job.held:
+                    self._held_queued[job.priority] -= 1
+                job.state = "done"
+                job.finished_at = self.clock()
+                exc = DeadlineExpired(
+                    f"deadline {job.deadline_s:.3f}s expired after "
+                    f"{self.clock() - job.submitted_at:.3f}s in queue")
+                job._exc = exc
+                self.counts["expired"] = self.counts.get("expired", 0) + 1
+                self.timeline.count("sched.expired")
+                job._done.set()
+                if job.on_drop is not None:
+                    # On its own thread, exactly as fn would have run:
+                    # the hook reaches back into the service layer
+                    # (its lock), which may be held by whoever called
+                    # submit() into this dispatch round.
+                    threading.Thread(
+                        target=self._run_drop, args=(job, exc),
+                        name=f"blit-serve-drop-{job.client}",
+                        daemon=True).start()
+                continue
             job.state = "running"
             job.started_at = self.clock()
             self._running += 1
@@ -389,6 +471,13 @@ class Scheduler:
                 target=self._run, args=(job,),
                 name=f"blit-serve-{job.client}", daemon=True,
             ).start()
+
+    def _run_drop(self, job: Job, exc: BaseException) -> None:
+        try:
+            job.on_drop(exc)
+        except Exception:  # noqa: BLE001 — a drop hook must not wedge
+            log.warning("on_drop hook for client %r failed", job.client,
+                        exc_info=True)
 
     def _run(self, job: Job) -> None:
         t0 = time.perf_counter()
@@ -461,6 +550,31 @@ class Scheduler:
             h = self.wait_hist
             return {"p50": h.percentile(0.50), "p99": h.percentile(0.99),
                     "n": h.n}
+
+    def drain(self, timeout: Optional[float] = 30.0,
+              cancel_queued: bool = True) -> int:
+        """Graceful shutdown (ISSUE 14 satellite: the SIGTERM path):
+        refuse new work NOW, optionally cancel everything still queued
+        (delivering :class:`Cancelled` — a drain has no future in which
+        to run them), and wait for the running jobs to finish.  Returns
+        the number of queued jobs cancelled.  In-flight work always
+        completes — drain never interrupts a running reduction; live
+        sessions (``hold=True``) end when their SOURCES are closed,
+        which is :meth:`blit.serve.service.ProductService.drain`'s job
+        before it calls here."""
+        self._closed = True
+        cancelled = 0
+        if cancel_queued:
+            with self._lock:
+                jobs: list = []
+                for per_client in self._queues.values():
+                    for q in per_client.values():
+                        jobs.extend(q)
+            for job in jobs:
+                if self.cancel(job):
+                    cancelled += 1
+        self.close(timeout)
+        return cancelled
 
     def close(self, timeout: Optional[float] = 30.0) -> None:
         """Refuse new work and wait for queued+running jobs to drain."""

@@ -214,7 +214,8 @@ class _Flight:
     """One single-flight group: every ticket for the same fingerprint
     submitted while the reduction is in flight rides this object."""
 
-    __slots__ = ("fingerprint", "tickets", "job", "result", "exc", "done")
+    __slots__ = ("fingerprint", "tickets", "job", "result", "exc", "done",
+                 "source")
 
     def __init__(self, fingerprint: str):
         self.fingerprint = fingerprint
@@ -223,6 +224,11 @@ class _Flight:
         self.result: Optional[Tuple[Dict, np.ndarray]] = None
         self.exc: Optional[BaseException] = None
         self.done = threading.Event()
+        # Live sessions only (kind="stream"): the ChunkSource feeding the
+        # in-flight stream_reduce, kept so drain() can stop it gracefully
+        # (ISSUE 14 satellite) — the session finishes with what arrived
+        # and its capacity hold releases instead of leaking.
+        self.source = None
 
 
 @dataclass
@@ -277,6 +283,11 @@ class ProductService:
         )
         self._lock = threading.Lock()
         self._flights: Dict[str, _Flight] = {}
+        # Graceful-drain latch (ISSUE 14): once set, submissions are
+        # REFUSED with Overloaded (the HTTP layer answers 503 so a fleet
+        # front door fails over to a replica) while in-flight work
+        # finishes and live-session holds release.
+        self._draining = False
         # In-flight live sessions' DECLARED lengths (kind="stream"
         # session_s; None = undeclared) — the operator-facing view of
         # how long the held capacity expects to stay pinned (stats()).
@@ -328,6 +339,13 @@ class ProductService:
         :class:`~blit.serve.scheduler.Overloaded` when admission control
         refuses, and ``OSError`` when the raw input does not exist (an
         address over unknown bytes is a caller bug, found at the door)."""
+        if self._draining:
+            with self._lock:
+                self.counts["rejected"] += 1
+            raise Overloaded("service is draining (shutdown in "
+                             "progress); retry another replica",
+                             retry_after_s=self.scheduler._retry_after_s(
+                                 1.0))
         if request.kind == "stream":
             if deadline_s is not None:
                 # The deadline estimator models BOUNDED jobs; silently
@@ -373,6 +391,11 @@ class ProductService:
                     lambda: self._reduce_and_publish(fp, request, flight,
                                                      ctx),
                     priority=priority, client=client, deadline_s=deadline_s,
+                    # Dispatch-time deadline expiry DROPS the job
+                    # without running fn — the flight must still fail,
+                    # or waiters hang and later identical requests
+                    # coalesce onto a dead group forever.
+                    on_drop=lambda e: self._finish(fp, flight, exc=e),
                 )
             except BaseException as e:
                 # ANY admission failure (Overloaded, a closed scheduler,
@@ -446,6 +469,7 @@ class ProductService:
                     src = FileTailSource(
                         request.raw,
                         idle_timeout_s=request.idle_timeout_s)
+                flight.source = src  # drain() stops it gracefully
                 hdr = stream_reduce(src, request.out, reducer=reducer,
                                     resume=True)
             data = np.zeros(
@@ -599,6 +623,59 @@ class ProductService:
             out["held_declared_s"] = sum(
                 s for s in self._live_declared.values() if s)
         return out
+
+    def drain(self, timeout: Optional[float] = 30.0) -> Dict[str, int]:
+        """Graceful shutdown (ISSUE 14 satellite — the SIGTERM path):
+
+        1. refuse new submissions (:class:`Overloaded`; the HTTP layer
+           answers 503 so a fleet front door fails over to a replica),
+        2. STOP every in-flight live session's chunk source — the
+           session finishes cleanly with the chunks that arrived, its
+           resumable cursor stays rejoinable, and its ``kind="stream"``
+           capacity hold RELEASES instead of leaking on interpreter
+           exit,
+        3. cancel still-queued jobs and wait for running ones
+           (:meth:`Scheduler.drain`).
+
+        Returns ``{"cancelled": queued jobs cancelled, "stopped": live
+        sources stopped}``.  Idempotent; ``close()`` afterwards is
+        still the teardown."""
+        self._draining = True
+        # Live flights whose job was just dispatched may not have built
+        # their source yet (the submit→_run_stream window) — poll
+        # briefly so a drain racing a fresh session still stops it.
+        deadline = time.monotonic() + 2.0
+        while True:
+            with self._lock:
+                live = [f for fp, f in self._flights.items()
+                        if fp.startswith("live:") and not f.done.is_set()]
+                sources = [f.source for f in live if f.source is not None]
+            if len(sources) == len(live) or time.monotonic() >= deadline:
+                break
+            time.sleep(0.01)
+        stopped = 0
+        for src in sources:
+            try:
+                src.stop()
+                stopped += 1
+            except Exception:  # noqa: BLE001 — drain must not die mid-way
+                log.warning("drain: stopping a live source failed",
+                            exc_info=True)
+        self.timeline.count("serve.drain")
+        cancelled = self.scheduler.drain(timeout)
+        # Flights whose job was cancelled while queued never reached
+        # _reduce_and_publish — deliver Cancelled to their tickets so no
+        # waiter blocks on a drained service forever.
+        with self._lock:
+            orphaned = [(fp, f) for fp, f in list(self._flights.items())
+                        if f.job is not None and f.job.state == "cancelled"]
+        for fp, flight in orphaned:
+            self._finish(fp, flight,
+                         exc=Cancelled("service drained while queued"))
+        return {"cancelled": cancelled, "stopped": stopped}
+
+    def draining(self) -> bool:
+        return self._draining
 
     def close(self, timeout: Optional[float] = 30.0) -> None:
         if self._scrubber is not None:

@@ -82,6 +82,15 @@ class ChunkSource:
     def close(self) -> None:
         """Release any held resources (idempotent)."""
 
+    def stop(self) -> None:
+        """Graceful drain (ISSUE 14 satellite): deliver nothing more —
+        the session ends cleanly with the chunks that already arrived
+        (the assembler totals what was delivered), so a draining serve
+        process finishes its in-flight live product, releases its
+        capacity hold, and — with ``resume=True`` — leaves a rejoinable
+        cursor for the consumer that takes over."""
+        self.finished = True
+
 
 class QueueSource(ChunkSource):
     """In-memory source: :meth:`push` chunks from the test (any order),
@@ -166,6 +175,8 @@ class ReplaySource(ChunkSource):
         self._t0: Optional[float] = None
 
     def get(self, timeout: float) -> Optional[StreamChunk]:
+        if self.finished:
+            return None  # stop() mid-replay: drain with what arrived
         if self._pos >= len(self._sched):
             self.finished = True
             self.total = self._nblocks

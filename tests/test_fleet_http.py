@@ -1,0 +1,234 @@
+"""The fleet wire + PeerServer (blit/serve/http.py; ISSUE 14):
+product round-trips byte-identical over HTTP, the Overloaded→503
+mapping honoring the jittered ``Retry-After``, DeadlineExpired→504,
+deadline propagation ON the wire, /healthz (incl. draining), /metrics
+parseability, /warm cache-warming, and the wire codecs."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+from blit.monitor import parse_prometheus  # noqa: E402
+from blit.observability import Timeline  # noqa: E402
+from blit.serve import (  # noqa: E402
+    DeadlineExpired,
+    Overloaded,
+    PeerServer,
+    ProductCache,
+    ProductRequest,
+    ProductService,
+    Scheduler,
+)
+from blit.serve.cache import fingerprint_for  # noqa: E402
+from blit.serve.http import (  # noqa: E402
+    decode_product,
+    encode_product,
+    http_json,
+    request_from_wire,
+    retry_after_from,
+    wire_request,
+)
+from blit.testing import synth_raw  # noqa: E402
+
+NFFT = 128
+NTIME = (8 + 3) * NFFT
+
+
+@pytest.fixture
+def raw(tmp_path):
+    p = str(tmp_path / "a.raw")
+    synth_raw(p, nblocks=1, obsnchan=2, ntime_per_block=NTIME,
+              tone_chan=1)
+    return p
+
+
+@pytest.fixture
+def peer(tmp_path):
+    tl = Timeline()
+    service = ProductService(
+        cache=ProductCache(str(tmp_path / "cache"), ram_bytes=1 << 24,
+                           timeline=tl),
+        scheduler=Scheduler(max_concurrency=2, queue_depth=8,
+                            timeline=tl, retry_seed=3),
+        timeline=tl,
+    )
+    server = PeerServer(service, name="p0",
+                        lease_dir=str(tmp_path / "leases"), proc=0,
+                        beat_interval_s=0.05).start()
+    yield server
+    server.close()
+    service.close(5)
+
+
+class TestWireCodecs:
+    def test_product_roundtrip_is_byte_exact(self):
+        hdr = {"nchans": 4, "tsamp": 1e-5, "src": "unit"}
+        data = np.arange(24, dtype=np.float32).reshape(2, 3, 4) * 0.37
+        h2, d2 = decode_product(encode_product(hdr, data))
+        assert h2 == hdr
+        assert d2.dtype == np.float32
+        assert d2.tobytes() == data.tobytes()
+        assert not d2.flags.writeable  # the frozen-result contract
+
+    def test_request_roundtrip(self, raw):
+        req = ProductRequest(raw=raw, nfft=256, nint=2, fqav_by=2)
+        doc = wire_request(req, priority=2, client="c1", deadline_s=3.5)
+        req2, priority, client, deadline = request_from_wire(doc)
+        assert (priority, client, deadline) == (2, "c1", 3.5)
+        assert req2.nfft == 256 and req2.nint == 2 and req2.fqav_by == 2
+
+    def test_stream_requests_refuse_the_wire(self, raw):
+        req = ProductRequest(raw=raw, kind="stream", out="/tmp/x.fil")
+        with pytest.raises(ValueError, match="stream"):
+            wire_request(req)
+
+
+class TestPeerServer:
+    def test_product_over_http_matches_direct(self, peer, raw):
+        req = ProductRequest(raw=raw, nfft=NFFT, nint=1)
+        status, _, body = http_json("POST", peer.url, "/product",
+                                    wire_request(req), timeout=120)
+        assert status == 200
+        _, via_http = decode_product(body)
+        _, direct = peer.service.get(req, timeout=120)
+        assert np.array_equal(via_http, direct)
+
+    def test_overloaded_maps_to_503_with_jittered_retry_after(
+            self, peer, raw, monkeypatch):
+        def refuse(*a, **kw):
+            raise Overloaded("queue full", retry_after_s=0.321)
+
+        monkeypatch.setattr(peer.service, "get", refuse)
+        status, headers, body = http_json(
+            "POST", peer.url, "/product",
+            wire_request(ProductRequest(raw=raw, nfft=NFFT)), timeout=30)
+        assert status == 503
+        # The satellite's contract: the jittered hint rides the HTTP
+        # header AND the body, exactly.
+        assert headers["retry-after"] == "0.321"
+        assert body["retry_after_s"] == 0.321
+        assert retry_after_from(headers, body) == 0.321
+
+    def test_deadline_expired_maps_to_504(self, peer, raw, monkeypatch):
+        def expire(*a, **kw):
+            raise DeadlineExpired("dead on arrival")
+
+        monkeypatch.setattr(peer.service, "get", expire)
+        status, _, body = http_json(
+            "POST", peer.url, "/product",
+            wire_request(ProductRequest(raw=raw, nfft=NFFT)), timeout=30)
+        assert status == 504
+        assert body["etype"] == "DeadlineExpired"
+
+    def test_deadline_rides_the_wire_into_the_scheduler(
+            self, peer, raw, monkeypatch):
+        seen = {}
+        real = peer.service.get
+
+        def spy(req, **kw):
+            seen.update(kw)
+            return real(req, **kw)
+
+        monkeypatch.setattr(peer.service, "get", spy)
+        http_json("POST", peer.url, "/product",
+                  wire_request(ProductRequest(raw=raw, nfft=NFFT),
+                               deadline_s=7.5), timeout=120)
+        assert seen["deadline_s"] == 7.5
+
+    def test_healthz_ok_then_draining(self, peer):
+        status, _, body = http_json("GET", peer.url, "/healthz")
+        assert status == 200 and body["ok"] and body["name"] == "p0"
+        peer.service._draining = True
+        _, _, degraded = http_json("GET", peer.url, "/healthz")
+        assert not degraded["ok"]
+        assert "draining" in degraded["reasons"]
+
+    def test_metrics_parse_as_prometheus(self, peer, raw):
+        peer.service.get(ProductRequest(raw=raw, nfft=NFFT), timeout=120)
+        status, _, text = http_json("GET", peer.url, "/metrics")
+        assert status == 200
+        samples = parse_prometheus(text)
+        assert samples  # non-empty, every line parseable
+
+    def test_warm_populates_the_cache(self, peer, raw):
+        req = ProductRequest(raw=raw, nfft=NFFT, nint=1)
+        fp = fingerprint_for(req.reducer(), raw)
+        status, _, body = http_json("POST", peer.url, "/warm",
+                                    {"recipes": [req.recipe()]},
+                                    timeout=30)
+        assert status == 202 and body["accepted"] == 1
+        deadline = time.monotonic() + 60
+        while not peer.service.cache.contains(fp):
+            assert time.monotonic() < deadline, "warm never landed"
+            time.sleep(0.05)
+
+    def test_lease_beats_land(self, peer, tmp_path):
+        from blit.recover import lease_age_s
+
+        time.sleep(0.2)
+        age = lease_age_s(str(tmp_path / "leases"), 0)
+        assert age is not None and age < 5.0
+
+    def test_stats_surface(self, peer, raw):
+        peer.service.get(ProductRequest(raw=raw, nfft=NFFT), timeout=120)
+        peer.service.get(ProductRequest(raw=raw, nfft=NFFT), timeout=120)
+        status, _, s = http_json("GET", peer.url, "/stats")
+        assert status == 200
+        assert s["name"] == "p0"
+        assert s["cache"]["hit.ram"] >= 1
+        assert s["hot"], "hot-entry tracking must surface"
+
+    def test_unknown_route_404s(self, peer):
+        status, _, _ = http_json("GET", peer.url, "/nope")
+        assert status == 404
+
+    def test_drain_endpoint_refuses_new_work(self, peer, raw):
+        status, _, body = http_json("POST", peer.url, "/drain", {})
+        assert status == 200 and body["draining"]
+        deadline = time.monotonic() + 10
+        while not peer.service.draining():
+            assert time.monotonic() < deadline
+            time.sleep(0.02)
+        # In-flight finished, new work refused at the door with a 503.
+        deadline = time.monotonic() + 10
+        while True:
+            status, _, _ = http_json(
+                "POST", peer.url, "/product",
+                wire_request(ProductRequest(raw=raw, nfft=NFFT)),
+                timeout=30)
+            if status == 503:
+                break
+            assert time.monotonic() < deadline
+            time.sleep(0.05)
+
+
+class TestConcurrentHTTP:
+    def test_parallel_identical_requests_coalesce_on_the_peer(
+            self, peer, raw):
+        req = ProductRequest(raw=raw, nfft=NFFT, nint=1)
+        wire = wire_request(req)
+        results = []
+        errors = []
+
+        def hit():
+            try:
+                status, _, body = http_json("POST", peer.url, "/product",
+                                            wire, timeout=120)
+                assert status == 200
+                results.append(decode_product(body)[1].tobytes())
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors.append(e)
+
+        threads = [threading.Thread(target=hit) for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        assert len(set(results)) == 1  # byte-identical for every caller
+        # Single-flight + cache: at most one reduction was scheduled.
+        assert peer.service.counts["scheduled"] == 1

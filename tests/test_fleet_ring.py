@@ -1,0 +1,128 @@
+"""Consistent-hash ring invariants (blit/serve/ring.py; ISSUE 14
+satellite): uniform load spread within bounds, minimal key movement on
+peer join/leave, DETERMINISTIC ownership across processes (sha256, not
+PYTHONHASHSEED-poisoned ``hash()``), and replica sets that never
+collapse onto one host."""
+
+import json
+import subprocess
+import sys
+
+from blit.serve.ring import HashRing, ring_hash
+
+KEYS = [f"fingerprint-{i:05d}" for i in range(4000)]
+PEERS = [f"peer{i}" for i in range(8)]
+
+
+class TestSpread:
+    def test_uniform_within_bounds(self):
+        # With 128 vnodes per peer, every peer's share of a large
+        # keyspace stays within a small factor of fair — the bound a
+        # fleet's capacity planning relies on.
+        ring = HashRing(PEERS, vnodes=128)
+        spread = ring.spread(KEYS)
+        fair = len(KEYS) / len(PEERS)
+        assert sum(spread.values()) == len(KEYS)
+        for peer, n in spread.items():
+            assert 0.45 * fair <= n <= 2.0 * fair, (peer, n, fair)
+
+    def test_every_peer_owns_something(self):
+        ring = HashRing(PEERS, vnodes=128)
+        assert all(n > 0 for n in ring.spread(KEYS).values())
+
+
+class TestMinimalMovement:
+    def test_leave_moves_only_the_leavers_keys(self):
+        before = HashRing(PEERS, vnodes=128)
+        after = HashRing(PEERS, vnodes=128)
+        victim = PEERS[3]
+        after.remove(victim)
+        owned = before.spread(KEYS)[victim]
+        moved, total = before.moved(KEYS, after)
+        # EXACTLY the victim's keys move (consistent hashing's whole
+        # point): everyone else's owner is untouched.
+        assert moved == owned
+        assert moved <= 2.0 * total / len(PEERS)
+        for k in KEYS:
+            if before.owner(k) != victim:
+                assert after.owner(k) == before.owner(k)
+
+    def test_join_moves_only_to_the_joiner(self):
+        small = HashRing(PEERS[:-1], vnodes=128)
+        grown = HashRing(PEERS[:-1], vnodes=128)
+        grown.add(PEERS[-1])
+        for k in KEYS:
+            if grown.owner(k) != PEERS[-1]:
+                assert grown.owner(k) == small.owner(k)
+        moved, total = small.moved(KEYS, grown)
+        assert 0 < moved <= 2.0 * total / len(PEERS)
+
+    def test_remove_then_readd_restores_ownership(self):
+        ring = HashRing(PEERS, vnodes=64)
+        want = {k: ring.owner(k) for k in KEYS[:500]}
+        ring.remove(PEERS[2])
+        ring.add(PEERS[2])
+        assert {k: ring.owner(k) for k in KEYS[:500]} == want
+
+
+class TestDeterminism:
+    def test_sha256_positions_are_stable(self):
+        # Pin two literal positions: a refactor that silently changes
+        # the hash breaks every deployed ring's ownership.
+        assert ring_hash("peer0#0") == int.from_bytes(
+            __import__("hashlib").sha256(b"peer0#0").digest()[:8], "big")
+        assert ring_hash("a") != ring_hash("b")
+
+    def test_ownership_identical_across_processes(self):
+        # The cross-process agreement contract: a SEPARATE interpreter
+        # (fresh PYTHONHASHSEED) computes the same owner sets.
+        ring = HashRing(PEERS, vnodes=64, replicas=3)
+        keys = KEYS[:50]
+        local = {k: ring.owners(k) for k in keys}
+        code = (
+            "import json, sys\n"
+            "from blit.serve.ring import HashRing\n"
+            "peers = json.loads(sys.argv[1]); keys = json.loads(sys.argv[2])\n"
+            "ring = HashRing(peers, vnodes=64, replicas=3)\n"
+            "print(json.dumps({k: ring.owners(k) for k in keys}))\n"
+        )
+        out = subprocess.run(
+            [sys.executable, "-c", code, json.dumps(PEERS),
+             json.dumps(keys)],
+            capture_output=True, text=True, check=True)
+        assert json.loads(out.stdout) == local
+
+
+class TestReplicaSets:
+    def test_replicas_are_distinct_peers(self):
+        ring = HashRing(PEERS, vnodes=128, replicas=3)
+        for k in KEYS[:1000]:
+            owners = ring.owners(k)
+            assert len(owners) == 3
+            assert len(set(owners)) == 3  # never collapse onto one host
+
+    def test_fewer_peers_than_replicas_returns_them_all(self):
+        ring = HashRing(["a", "b"], replicas=3)
+        for k in KEYS[:50]:
+            assert sorted(ring.owners(k)) == ["a", "b"]
+
+    def test_exclude_skips_without_shrinking_the_walk(self):
+        ring = HashRing(PEERS, vnodes=64, replicas=2)
+        k = KEYS[0]
+        owner = ring.owner(k)
+        owners = ring.owners(k, exclude=[owner])
+        assert owner not in owners
+        assert len(owners) == 2
+
+    def test_empty_ring(self):
+        ring = HashRing([])
+        assert ring.owners("anything") == []
+        assert ring.owner("anything") is None
+
+    def test_membership_idempotent(self):
+        ring = HashRing(["a"])
+        assert not ring.add("a")
+        assert ring.add("b")
+        assert ring.remove("b")
+        assert not ring.remove("b")
+        assert ring.peers() == ["a"]

@@ -60,6 +60,7 @@ class TestPublicSurface:
         "ProductCache",
         "Scheduler",
         "Overloaded",
+        "FleetFrontDoor",
     )
 
     def test_top_level_reexports_serve_layer(self):
@@ -74,8 +75,10 @@ class TestPublicSurface:
         import blit.serve
 
         expected = {
-            "Cancelled", "Job", "Overloaded", "ProductCache",
-            "ProductRequest", "ProductService", "Scheduler", "Ticket",
+            "Cancelled", "DeadlineExpired", "FleetError",
+            "FleetFrontDoor", "FrontDoorServer", "HashRing", "Job",
+            "Overloaded", "PeerServer", "ProductCache", "ProductRequest",
+            "ProductService", "Scheduler", "Ticket",
             "fingerprint_for", "reduction_fingerprint",
         }
         assert set(blit.serve.__all__) == expected
