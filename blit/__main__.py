@@ -554,7 +554,8 @@ def _cmd_fleet_peer(args: argparse.Namespace) -> int:
 def _spawn_fleet_peers(td: str, npeers: int, *, concurrency: int,
                        queue_depth: int, ram_bytes: int,
                        beat_interval_s: float = 0.2,
-                       bringup_timeout_s: float = 120.0):
+                       bringup_timeout_s: float = 120.0,
+                       extra_env: Optional[dict] = None):
     """Bring up ``npeers`` REAL ``blit fleet-peer`` subprocesses (the
     bench/chaos rig): per-peer cache dirs + one shared lease dir under
     ``td``, ephemeral ports published through port files.  Returns
@@ -583,6 +584,7 @@ def _spawn_fleet_peers(td: str, npeers: int, *, concurrency: int,
                "--retry-seed", str(i)]
         env = dict(os.environ)
         env.setdefault("JAX_PLATFORMS", "cpu")
+        env.update(extra_env or {})
         logf = open(os.path.join(td, f"peer{i}.log"), "w")
         procs.append((subprocess.Popen(cmd, stdout=logf, stderr=logf,
                                        env=env), logf))
@@ -658,8 +660,8 @@ def _cmd_serve_bench(args: argparse.Namespace) -> int:
 
     if args.fleet:
         return _serve_bench_fleet(args)
-    rng = random.Random(args.seed)
-    tl = Timeline()
+    from blit.config import DEFAULT
+
     with tempfile.TemporaryDirectory(prefix="blit-serve-bench-") as td:
         # Distinct products = distinct synthetic recordings (tiny: the
         # bench measures the serving layer, not the channelizer).
@@ -670,78 +672,143 @@ def _cmd_serve_bench(args: argparse.Namespace) -> int:
             synth_raw(path, nblocks=1, obsnchan=2, ntime_per_block=ntime,
                       seed=i)
             reqs.append(ProductRequest(raw=path, nfft=args.nfft, nint=1))
-        cache_dir = os.path.join(td, "cache") if args.disk_cache else None
-        service = ProductService(
-            cache=ProductCache(cache_dir, ram_bytes=args.ram_bytes,
-                               timeline=tl),
-            scheduler=Scheduler(max_concurrency=args.concurrency,
-                                queue_depth=args.queue_depth, timeline=tl,
-                                retry_seed=args.seed),
-            timeline=tl,
-        )
-        # Graceful-shutdown satellite (ISSUE 14): SIGTERM/SIGINT drains
-        # the scheduler — in-flight jobs finish, queued ones deliver
-        # Cancelled, and kind="stream" capacity holds release instead
-        # of leaking on interpreter exit.
-        uninstall_signals = install_drain_handler(
-            lambda: service.drain(timeout=30.0))
-        # Zipfian popularity over the distinct products: p(k) ∝ 1/(k+1)^s.
+        # Zipfian popularity over the distinct products: p(k) ∝ 1/(k+1)^s
+        # — one pick sequence, replayed identically by every pass so the
+        # request-log A/B compares the same workload.
+        rng = random.Random(args.seed)
         weights = [1.0 / math.pow(k + 1, args.zipf_s)
                    for k in range(args.distinct)]
         picks = rng.choices(range(args.distinct), weights=weights,
                             k=args.requests)
-        errors: list = []
-        rejected = [0]
-        lock = threading.Lock()
-        it = iter(picks)
 
-        def client_loop(cid: int) -> None:
-            while True:
-                with lock:
-                    k = next(it, None)
-                if k is None:
-                    return
-                try:
-                    service.get(reqs[k], timeout=120,
-                                client=f"client{cid}")
-                except Overloaded:
-                    with lock:
-                        rejected[0] += 1
-                except Exception as e:  # noqa: BLE001 — reported below
-                    with lock:
-                        errors.append(repr(e))
+        def one_pass(request_log_dir, pass_id: int = 0) -> dict:
+            tl = Timeline()
+            cache_dir = (os.path.join(td, f"cache{pass_id}")
+                         if args.disk_cache else None)
+            # Pin the env for this pass's service construction: an
+            # ambient BLIT_REQUEST_LOG would override the config and
+            # silently invalidate the off/on A/B ("" = disabled, the
+            # request_log_defaults encoding).
+            prev = os.environ.get("BLIT_REQUEST_LOG")
+            os.environ["BLIT_REQUEST_LOG"] = request_log_dir or ""
+            try:
+                service = ProductService(
+                    cache=ProductCache(cache_dir,
+                                       ram_bytes=args.ram_bytes,
+                                       timeline=tl),
+                    scheduler=Scheduler(max_concurrency=args.concurrency,
+                                        queue_depth=args.queue_depth,
+                                        timeline=tl,
+                                        retry_seed=args.seed),
+                    timeline=tl,
+                    config=DEFAULT.with_(
+                        request_log_dir=request_log_dir),
+                )
+            finally:
+                if prev is None:
+                    os.environ.pop("BLIT_REQUEST_LOG", None)
+                else:
+                    os.environ["BLIT_REQUEST_LOG"] = prev
+            # Graceful-shutdown satellite (ISSUE 14): SIGTERM/SIGINT
+            # drains the scheduler — in-flight jobs finish, queued ones
+            # deliver Cancelled, and kind="stream" capacity holds
+            # release instead of leaking on interpreter exit.
+            uninstall_signals = install_drain_handler(
+                lambda: service.drain(timeout=30.0))
+            errors: list = []
+            rejected = [0]
+            lock = threading.Lock()
+            it = iter(picks)
 
-        t0 = _time.perf_counter()
-        threads = [threading.Thread(target=client_loop, args=(c,))
-                   for c in range(args.clients)]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        wall = _time.perf_counter() - t0
-        uninstall_signals()
-        service.close()
-        stats = service.stats()
-        qw = stats["queue_wait"]
-        print(json.dumps({
-            "requests": args.requests,
-            "distinct": args.distinct,
-            "clients": args.clients,
-            "zipf_s": args.zipf_s,
-            "wall_s": round(wall, 3),
-            "hit_rate": stats["hit_rate"],
-            "coalesced": stats["coalesced"],
-            "scheduled": stats["scheduled"],
-            "rejected_overloaded": rejected[0],
-            "queue_wait_p50_s": round(qw["p50"], 6),
-            "queue_wait_p99_s": round(qw["p99"], 6),
-            "cache": stats["cache"],
-            # Latency distributions (ISSUE 5): the bounded histograms the
-            # serving timeline accumulated — tails, not averages.
-            "hists": tl.report().get("hists", {}),
-            "errors": errors[:5],
-        }))
-        return 1 if errors else 0
+            def client_loop(cid: int) -> None:
+                while True:
+                    with lock:
+                        k = next(it, None)
+                    if k is None:
+                        return
+                    try:
+                        service.get(reqs[k], timeout=120,
+                                    client=f"client{cid}")
+                    except Overloaded:
+                        with lock:
+                            rejected[0] += 1
+                    except Exception as e:  # noqa: BLE001 — reported
+                        with lock:
+                            errors.append(repr(e))
+
+            t0 = _time.perf_counter()
+            threads = [threading.Thread(target=client_loop, args=(c,))
+                       for c in range(args.clients)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = _time.perf_counter() - t0
+            uninstall_signals()
+            service.close()
+            stats = service.stats()
+            qw = stats["queue_wait"]
+            rep = {
+                "requests": args.requests,
+                "distinct": args.distinct,
+                "clients": args.clients,
+                "zipf_s": args.zipf_s,
+                "wall_s": round(wall, 3),
+                "hit_rate": stats["hit_rate"],
+                "coalesced": stats["coalesced"],
+                "scheduled": stats["scheduled"],
+                "rejected_overloaded": rejected[0],
+                "queue_wait_p50_s": round(qw["p50"], 6),
+                "queue_wait_p99_s": round(qw["p99"], 6),
+                "cache": stats["cache"],
+                # Latency distributions (ISSUE 5): the bounded
+                # histograms the serving timeline accumulated — tails,
+                # not averages.
+                "hists": tl.report().get("hists", {}),
+                "errors": errors[:5],
+            }
+            if request_log_dir:
+                from blit import monitor
+
+                recs = monitor.read_requests(request_log_dir)
+                rep["request_log"] = monitor.aggregate_requests(recs)
+            return rep
+
+        if args.request_log_compare:
+            # The ISSUE 15 A/B (the --spans-compare discipline): the
+            # identical replay with request logging DISABLED then
+            # ENABLED — the report pins the disabled pass's record
+            # count at zero and prices the enabled pass.  An untimed
+            # warmup pass absorbs the XLA compiles first, so off/on
+            # compare warm against warm instead of cold against warm.
+            one_pass(None, 9)
+            off = one_pass(None, 0)
+            log_dir = args.request_log or os.path.join(td, "reqlog")
+            # Measured, not assumed: the disabled pass must have
+            # written NOTHING anywhere under the bench root.
+            import glob as _glob
+
+            off_records = len(_glob.glob(
+                os.path.join(td, "**", "requests-*.jsonl*"),
+                recursive=True))
+            on = one_pass(log_dir, 1)
+            overhead = (on["wall_s"] / off["wall_s"] - 1.0
+                        if off["wall_s"] else 0.0)
+            print(json.dumps({
+                "request_log_compare": True,
+                "off_wall_s": off["wall_s"],
+                "on_wall_s": on["wall_s"],
+                "overhead_pct": round(overhead * 100.0, 2),
+                "off_records": off_records,
+                "on_records": (on.get("request_log") or {}).get(
+                    "records", 0),
+                "off": off,
+                "on": on,
+            }))
+            return 1 if off["errors"] or on["errors"] else 0
+        rep = one_pass(args.request_log, 0)
+        print(json.dumps(rep))
+        return 1 if rep["errors"] else 0
 
 
 def _serve_bench_fleet(args: argparse.Namespace) -> int:
@@ -759,6 +826,8 @@ def _serve_bench_fleet(args: argparse.Namespace) -> int:
     import threading
     import time as _time
 
+    from blit import monitor, observability
+    from blit.config import DEFAULT
     from blit.observability import HistogramStats, Timeline
     from blit.serve import Overloaded, ProductRequest
     from blit.serve.fleet import FleetFrontDoor
@@ -776,15 +845,24 @@ def _serve_bench_fleet(args: argparse.Namespace) -> int:
             synth_raw(path, nblocks=1, obsnchan=2, ntime_per_block=ntime,
                       seed=i)
             reqs.append(ProductRequest(raw=path, nfft=args.nfft, nint=1))
+        # Request observability is ON for the fleet replay (ISSUE 15):
+        # the report's p50/p99 come from the access records, and the
+        # peers inherit the spool dir through their environment.  The
+        # door's env is pinned too — an ambient BLIT_REQUEST_LOG would
+        # override the config and send its records elsewhere.
+        reqlog_dir = args.request_log or os.path.join(td, "reqlog")
+        os.environ["BLIT_REQUEST_LOG"] = reqlog_dir
         procs, peers, lease_dir = _spawn_fleet_peers(
             td, args.peers, concurrency=args.concurrency,
-            queue_depth=args.queue_depth, ram_bytes=args.ram_bytes)
+            queue_depth=args.queue_depth, ram_bytes=args.ram_bytes,
+            extra_env={"BLIT_REQUEST_LOG": reqlog_dir})
         door = FleetFrontDoor(
             peers, lease_dir=lease_dir, timeline=tl,
             replicas=args.replicas, peer_ttl_s=args.peer_ttl,
             poll_s=min(0.1, args.peer_ttl / 4),
             hedge_floor_s=args.hedge_floor_ms / 1e3,
-            request_timeout_s=60.0).start()
+            request_timeout_s=60.0,
+            config=DEFAULT.with_(request_log_dir=reqlog_dir)).start()
         uninstall = install_drain_handler(lambda: door.drain())
         weights = [1.0 / math.pow(k + 1, args.zipf_s)
                    for k in range(args.distinct)]
@@ -856,6 +934,42 @@ def _serve_bench_fleet(args: argparse.Namespace) -> int:
                 }
             served_tier = tiers["hit.ram"] + tiers["hit.disk"]
             total_tier = served_tier + tiers["miss"]
+            # Fleet trace harvest (ISSUE 15 tentpole #4): stitch the
+            # peers' span batches (their live /snapshot endpoints, with
+            # histogram exemplars) and the door's own spans/hists into
+            # ONE reviewable artifact — the Perfetto export plus a raw
+            # .snapshot.json that `blit trace-view --fleet` reads after
+            # the peers are gone.
+            trace_block = None
+            if args.trace_out:
+                spans, hists = monitor.gather_trace_sources(
+                    list(peers.values()))
+                seen_ids = {s.get("span") for s in spans}
+                spans.extend(s for s in observability.tracer().span_dicts()
+                             if s.get("span") not in seen_ids)
+                for k, h in list(tl.hists.items()):
+                    if k in hists:
+                        hists[k].merge(h)
+                    else:
+                        hists[k] = HistogramStats.from_state(h.state())
+                stitcher = observability.Tracer(
+                    max_spans=max(1, len(spans)), enabled=True)
+                stitcher.ingest(spans)
+                stitcher.export_chrome(args.trace_out)
+                snap_path = args.trace_out + ".snapshot.json"
+                with open(snap_path, "w") as f:
+                    json.dump({"spans": spans,
+                               "hists": {k: h.state()
+                                         for k, h in hists.items()}}, f)
+                trace_block = dict(observability.trace_summary(spans),
+                                   out=args.trace_out,
+                                   snapshot=snap_path)
+            # The report's latency quantiles come from the ACCESS
+            # RECORDS (ISSUE 15 satellite): what the door actually
+            # logged per request, not a separate in-bench stopwatch.
+            all_recs = monitor.read_requests(reqlog_dir)
+            door_agg = monitor.aggregate_requests(
+                monitor.filter_requests(all_recs, role="door"))
             fstats = door.stats()
             c = fstats["counters"]
             hedges = c.get("fleet.hedge", 0)
@@ -899,8 +1013,19 @@ def _serve_bench_fleet(args: argparse.Namespace) -> int:
                 "rejected_overloaded": rejected[0],
                 "deadline_expired": expired[0],
                 "per_peer": per_peer,
+                "request_log": {
+                    "dir": reqlog_dir,
+                    "records": len(all_recs),
+                    "door_records": door_agg["records"],
+                    "p50_s": door_agg["p50_s"],
+                    "p99_s": door_agg["p99_s"],
+                    "by_status": door_agg["by_status"],
+                    "by_tier": door_agg["by_tier"],
+                },
                 "errors": errors[:5],
             }
+            if trace_block is not None:
+                report["trace"] = trace_block
             print(json.dumps(report))
         finally:
             uninstall()
@@ -1984,14 +2109,84 @@ def _cmd_bench_diff(args: argparse.Namespace) -> int:
 
 
 def _cmd_trace_view(args: argparse.Namespace) -> int:
-    """Render a flight-recorder dump into an incident summary."""
+    """Render a flight-recorder dump into an incident summary, or
+    (``--fleet``, ISSUE 15) stitch span batches from many processes —
+    monitor spools, saved snapshots, live ``/snapshot`` endpoints —
+    into ONE trace view: a Perfetto export (``--out``), per-trace trees
+    (``--trace``), and tail-bucket exemplar resolution
+    (``--exemplar METRIC`` → the trace id behind the slowest bucket)."""
     import json as _json
 
     from blit.observability import render_flight_dump
 
+    if args.fleet:
+        return _trace_view_fleet(args)
+    if not args.dump:
+        raise SystemExit("trace-view needs a flight dump path "
+                         "(or --fleet SOURCES)")
     with open(args.dump) as f:
         doc = _json.load(f)
     print(render_flight_dump(doc, tail=args.events))
+    if args.trace or args.exemplar or args.out:
+        # A flight dump is itself a span batch: reuse the fleet path so
+        # `trace-view dump.json --trace <id>` follows the dump's trace.
+        args.fleet = [args.dump]
+        return _trace_view_fleet(args)
+    return 0
+
+
+def _trace_view_fleet(args: argparse.Namespace) -> int:
+    """The fleet half of ``blit trace-view`` (ISSUE 15 tentpole #4)."""
+    from blit import monitor, observability
+
+    spans, hists = monitor.gather_trace_sources(args.fleet)
+    summary = observability.trace_summary(spans)
+    out = {"sources": list(args.fleet), **summary}
+    if args.out:
+        tr = observability.Tracer(max_spans=max(len(spans), 1),
+                                  enabled=True)
+        tr.ingest(spans)
+        tr.export_chrome(args.out)
+        out["out"] = args.out
+    exemplar_trace = None
+    if args.exemplar:
+        h = hists.get(args.exemplar)
+        ex = h.tail_exemplar() if h is not None else None
+        if ex is None:
+            print(json.dumps(out))
+            print(f"# no exemplar recorded for {args.exemplar!r} "
+                  f"({len(hists)} histogram(s) in the sources)",
+                  file=sys.stderr)
+            return 1
+        out["exemplar"] = {"metric": args.exemplar, **ex}
+        exemplar_trace = ex["trace"]
+    print(json.dumps(out))
+    for trace_id in ([args.trace] if args.trace else []) + (
+            [exemplar_trace] if exemplar_trace else []):
+        print(observability.render_trace_tree(spans, trace_id))
+    return 0
+
+
+def _cmd_requests(args: argparse.Namespace) -> int:
+    """``blit requests`` (ISSUE 15 tentpole #2): tail, filter and
+    aggregate a per-request access-record spool — the operator's "which
+    requests were slow, and whose trace do I open" surface."""
+    from blit import monitor
+
+    records = monitor.read_requests(args.spool, tail=args.tail)
+    records = monitor.filter_requests(
+        records, slow_ms=args.slow_ms, status=args.status,
+        client=args.client, role=args.role)
+    if args.aggregate:
+        agg = monitor.aggregate_requests(records)
+        print(json.dumps(agg) if args.json
+              else json.dumps(agg, indent=2))
+        return 0
+    if args.json:
+        for r in records:
+            print(json.dumps(r))
+    else:
+        print(monitor.render_requests(records))
     return 0
 
 
@@ -2393,6 +2588,20 @@ def main(argv: Optional[List[str]] = None) -> int:
     pb.add_argument("--deadline", type=float, default=None,
                     help="per-request deadline_s propagated through "
                          "the fleet (--fleet)")
+    pb.add_argument("--request-log", default=None, metavar="DIR",
+                    help="per-request access records land here "
+                         "(ISSUE 15; --fleet defaults to a temp spool "
+                         "so the report's p50/p99 always come from the "
+                         "records — point it somewhere to keep them)")
+    pb.add_argument("--request-log-compare", action="store_true",
+                    help="A/B the identical replay with request "
+                         "logging off then on and report the overhead "
+                         "(the --spans-compare discipline; non-fleet)")
+    pb.add_argument("--trace-out", default=None, metavar="PATH",
+                    help="with --fleet: stitch the peers' span batches "
+                         "+ the door's into one Perfetto trace at PATH "
+                         "(plus PATH.snapshot.json for trace-view "
+                         "--fleet)")
     pb.set_defaults(fn=_cmd_serve_bench)
 
     pfp = sub.add_parser(
@@ -2607,13 +2816,60 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     pv = sub.add_parser(
         "trace-view",
-        help="render a flight-recorder dump into an incident summary",
+        help="render a flight-recorder dump into an incident summary, "
+             "or stitch a fleet's span batches into one trace "
+             "(--fleet; ISSUE 15)",
     )
-    pv.add_argument("dump", help="flight-recorder JSON "
-                                 "(blit-flight-<host>-<pid>-<t>.json)")
+    pv.add_argument("dump", nargs="?", default=None,
+                    help="flight-recorder JSON "
+                         "(blit-flight-<host>-<pid>-<t>-<n>.json)")
     pv.add_argument("--events", type=int, default=40,
                     help="how many trailing ring events to show")
+    pv.add_argument("--fleet", nargs="+", default=None, metavar="SRC",
+                    help="stitch spans from these sources into one "
+                         "trace view: monitor spool dirs / .jsonl "
+                         "files, saved *.snapshot.json batches, flight "
+                         "dumps, or live http://host:port /snapshot "
+                         "endpoints")
+    pv.add_argument("--out", default=None,
+                    help="write the stitched spans as Chrome-trace-"
+                         "event JSON (Perfetto-loadable)")
+    pv.add_argument("--trace", default=None, metavar="ID",
+                    help="print one trace's span tree")
+    pv.add_argument("--exemplar", default=None, metavar="METRIC",
+                    help="resolve METRIC's tail-bucket exemplar to its "
+                         "trace id (and print that trace's tree when "
+                         "the spans are in the sources)")
     pv.set_defaults(fn=_cmd_trace_view)
+
+    pq = sub.add_parser(
+        "requests",
+        help="tail / filter / aggregate a per-request access-record "
+             "spool (BLIT_REQUEST_LOG; ISSUE 15)",
+    )
+    pq.add_argument("spool",
+                    help="request-log spool dir (requests-*.jsonl) or "
+                         "one log file")
+    pq.add_argument("--tail", type=int, default=None,
+                    help="keep only the newest N records")
+    pq.add_argument("--slow-ms", type=float, default=None,
+                    help="keep records at least this slow")
+    pq.add_argument("--status", default=None,
+                    help="keep one status (ok/overloaded/deadline/"
+                         "timeout/error, or an HTTP code like 503)")
+    pq.add_argument("--client", default=None,
+                    help="keep one client's records")
+    pq.add_argument("--role", default=None,
+                    choices=["door", "peer", "serve"],
+                    help="keep one component role's records")
+    pq.add_argument("--aggregate", action="store_true",
+                    help="print one summary (counts by status/tier, "
+                         "p50/p99, slowest records w/ trace ids) "
+                         "instead of the record table")
+    pq.add_argument("--json", action="store_true",
+                    help="machine output: one JSON record per line "
+                         "(or the compact aggregate)")
+    pq.set_defaults(fn=_cmd_requests)
 
     args = p.parse_args(argv)
     return args.fn(args)

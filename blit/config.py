@@ -245,6 +245,23 @@ class SiteConfig:
     fleet_hedge_floor_s: float = 0.05
     fleet_hedge_min_n: int = 16
     fleet_hot_hits: int = 3
+    # Fleet request observability (blit/observability.py RequestLog +
+    # histogram exemplars; ISSUE 15).  request_log_dir, when set, makes
+    # every serving component (ProductService, fleet front door, peer
+    # HTTP handler) append one bounded JSON-lines access record per
+    # request under that dir (`blit requests` tails/aggregates the
+    # spool); request_log_max_bytes/request_log_files bound each
+    # component's log by size rotation.  exemplars keeps the
+    # most-recent-trace-id-per-bucket exemplars on every histogram
+    # (OpenMetrics exemplar syntax on /metrics; `blit trace-view
+    # --exemplar` resolves a tail bucket to its trace).  Per-process
+    # overrides: BLIT_REQUEST_LOG / BLIT_REQUEST_LOG_MAX_BYTES /
+    # BLIT_REQUEST_LOG_FILES / BLIT_EXEMPLARS
+    # (:func:`request_log_defaults`).
+    request_log_dir: Optional[str] = None
+    request_log_max_bytes: int = 8 << 20
+    request_log_files: int = 4
+    exemplars: bool = True
 
     def io_retry_policy(self):
         """The :class:`blit.faults.RetryPolicy` for worker-side file I/O —
@@ -394,6 +411,12 @@ def monitor_defaults(config: SiteConfig = DEFAULT) -> Dict:
             "BLIT_MONITOR_INTERVAL", config.monitor_interval_s)),
         "port": port,
         "spool_dir": spool,
+        # Span batches on each spool sample (ISSUE 15 tentpole #4):
+        # every tick ships the spans finished since the last one, so a
+        # spool is a stitchable trace source (`blit trace-view --fleet`).
+        "spans": os.environ.get(
+            "BLIT_MONITOR_SPANS", "").lower() not in ("", "0", "false",
+                                                      "off"),
         "enabled": port is not None or spool is not None,
     }
 
@@ -499,6 +522,31 @@ def fleet_defaults(config: SiteConfig = DEFAULT) -> Dict:
             "BLIT_FLEET_HEDGE_MIN_N", config.fleet_hedge_min_n)),
         "hot_hits": int(os.environ.get(
             "BLIT_FLEET_HOT_HITS", config.fleet_hot_hits)),
+    }
+
+
+def request_log_defaults(config: SiteConfig = DEFAULT) -> Dict:
+    """The effective request-observability knob set (ISSUE 15):
+    ``config``'s values with per-process ``BLIT_REQUEST_LOG*`` /
+    ``BLIT_EXEMPLARS`` environment overrides applied — the
+    :func:`stream_defaults` pattern, resolved when a serving component
+    constructs its :class:`blit.observability.RequestLog`.  ``dir`` is
+    None when request logging is disabled (the default — disabled must
+    cost one dict lookup per request)."""
+    d = os.environ.get("BLIT_REQUEST_LOG")
+    if d is None:
+        d = config.request_log_dir
+    elif not d:
+        d = None
+    ex = os.environ.get("BLIT_EXEMPLARS")
+    return {
+        "dir": d,
+        "max_bytes": int(os.environ.get(
+            "BLIT_REQUEST_LOG_MAX_BYTES", config.request_log_max_bytes)),
+        "files": int(os.environ.get(
+            "BLIT_REQUEST_LOG_FILES", config.request_log_files)),
+        "exemplars": (config.exemplars if ex is None
+                      else ex.lower() not in ("", "0", "false", "off")),
     }
 
 

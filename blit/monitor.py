@@ -383,9 +383,20 @@ def _make_http_server(publisher, port: int):
                     body = json.dumps(publisher.health()).encode()
                     ctype = "application/json"
                 elif self.path.startswith("/metrics"):
+                    from blit.observability import (
+                        OPENMETRICS_CTYPE,
+                        PROM_CTYPE,
+                        wants_openmetrics,
+                    )
+
+                    # Exemplars only in the negotiated OpenMetrics
+                    # exposition (ISSUE 15) — the legacy text parser
+                    # rejects the suffix.
+                    om = wants_openmetrics(self.headers.get("Accept"))
                     body = render_prometheus(
-                        publisher.fleet_report()).encode()
-                    ctype = "text/plain; version=0.0.4"
+                        publisher.fleet_report(),
+                        openmetrics=om).encode()
+                    ctype = OPENMETRICS_CTYPE if om else PROM_CTYPE
                 elif self.path.startswith("/snapshot"):
                     sample = publisher.last_sample or publisher.tick()
                     body = json.dumps(sample).encode()
@@ -426,12 +437,18 @@ class MetricsPublisher:
                  timeline: Optional[Timeline] = None,
                  objectives: Optional[Iterable] = None,
                  config: SiteConfig = DEFAULT,
+                 spans: Optional[bool] = None,
                  clock: Callable[[], float] = time.time):
         d = monitor_defaults(config)
         self.interval_s = (d["interval_s"] if interval_s is None
                            else float(interval_s))
         self.spool_dir = spool_dir if spool_dir is not None \
             else d["spool_dir"]
+        # Span batches per sample (ISSUE 15 tentpole #4): each tick
+        # ships the spans finished since the last, so the spool doubles
+        # as a fleet trace source (BLIT_MONITOR_SPANS / ctor arg).
+        self.spans = d["spans"] if spans is None else bool(spans)
+        self._span_cursor = 0
         self.clock = clock
         # Publisher-owned gauges (device HBM, derived ICI rate) live on
         # their own timeline so sampling never mutates a caller's.
@@ -559,6 +576,12 @@ class MetricsPublisher:
                 "slo": self.slo.report(),
                 "alerts": alerts,
             }
+            if self.spans:
+                from blit import observability
+
+                self._span_cursor, new_spans = (
+                    observability.tracer().spans_since(self._span_cursor))
+                sample["spans"] = new_spans
             self.seq += 1
             self.last_sample = sample
             if self._spool_f is not None:
@@ -1015,8 +1038,13 @@ def watch_loop(render: Callable[[], str], interval_s: float,
 
 # -- Prometheus exposition parsing ------------------------------------------
 
+# A sample line, with an optional OpenMetrics exemplar suffix
+# (`value # {trace_id="..."} exemplar-value [timestamp]`, ISSUE 15) —
+# the exemplar is captured (group 4) but optional, so pre-exemplar
+# scrape bodies parse unchanged.
 _SAMPLE_RE = re.compile(
-    r"^([A-Za-z_:][A-Za-z0-9_:]*)(?:\{(.*)\})?\s+(\S+)$")
+    r"^([A-Za-z_:][A-Za-z0-9_:]*)(?:\{(.*?)\})?\s+(\S+)"
+    r"(?:\s+#\s+\{(.*?)\}\s+(\S+)(?:\s+(\S+))?)?$")
 _LABEL_RE = re.compile(r'([A-Za-z_][A-Za-z0-9_]*)="((?:[^"\\]|\\.)*)"')
 
 
@@ -1031,7 +1059,10 @@ def parse_prometheus(text: str
     """Parse a Prometheus exposition body into ``(name, labels, value)``
     samples — the round-trip check behind the native-histogram
     exposition (tests) and the CI monitor smoke's "parseable /metrics"
-    assertion.  Raises ``ValueError`` on an unparseable sample line."""
+    assertion.  OpenMetrics exemplar suffixes on ``_bucket`` lines
+    (ISSUE 15) are tolerated and dropped — use
+    :func:`parse_prometheus_exemplars` to read them.  Raises
+    ``ValueError`` on an unparseable sample line."""
     out: List[Tuple[str, Dict[str, str], float]] = []
     for line in text.splitlines():
         line = line.strip()
@@ -1040,11 +1071,273 @@ def parse_prometheus(text: str
         m = _SAMPLE_RE.match(line)
         if m is None:
             raise ValueError(f"unparseable exposition line: {line!r}")
-        name, labels_s, value = m.groups()
+        name, labels_s, value = m.groups()[:3]
         labels = {k: _unescape(v)
                   for k, v in _LABEL_RE.findall(labels_s or "")}
         out.append((name, labels, float(value)))
     return out
+
+
+def parse_prometheus_exemplars(
+        text: str) -> List[Tuple[str, Dict[str, str], Dict]]:
+    """The exemplars of an exposition body (ISSUE 15): every sample
+    line carrying an OpenMetrics ``# {...} value [ts]`` suffix, as
+    ``(metric name, labels, {"labels", "value", "t"})``."""
+    out: List[Tuple[str, Dict[str, str], Dict]] = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None or m.group(4) is None:
+            continue
+        name, labels_s, _, ex_labels, ex_value, ex_t = m.groups()
+        labels = {k: _unescape(v)
+                  for k, v in _LABEL_RE.findall(labels_s or "")}
+        ex = {"labels": {k: _unescape(v)
+                         for k, v in _LABEL_RE.findall(ex_labels or "")},
+              "value": float(ex_value)}
+        if ex_t is not None:
+            ex["t"] = float(ex_t)
+        out.append((name, labels, ex))
+    return out
+
+
+# -- per-request access records: read / filter / aggregate (ISSUE 15) -------
+
+
+def read_requests(src: str, tail: Optional[int] = None) -> List[Dict]:
+    """Access records from a request-log spool: ``src`` is a directory
+    (every ``requests-*.jsonl`` member, rotations included), a single
+    ``.jsonl`` file, or a rotated member.  Records come back
+    time-ordered; a torn trailing line (a process mid-write) is
+    skipped.  ``tail`` keeps only the newest N."""
+    paths: List[str] = []
+    if os.path.isdir(src):
+        paths = sorted(glob.glob(os.path.join(src, "requests-*.jsonl*")))
+        if not paths:
+            paths = sorted(glob.glob(os.path.join(src, "*.jsonl*")))
+    else:
+        paths = [src]
+    records: List[Dict] = []
+    for path in paths:
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        doc = json.loads(line)
+                    except ValueError:
+                        continue
+                    if isinstance(doc, dict):
+                        records.append(doc)
+        except OSError:
+            continue
+    records.sort(key=lambda r: r.get("t", 0.0))
+    if tail is not None:
+        records = records[-max(0, int(tail)):]
+    return records
+
+
+def filter_requests(records: Iterable[Dict], *,
+                    slow_ms: Optional[float] = None,
+                    status: Optional[str] = None,
+                    client: Optional[str] = None,
+                    role: Optional[str] = None) -> List[Dict]:
+    """The ``blit requests`` filter surface: keep records at least
+    ``slow_ms`` slow, matching a status (name like ``overloaded`` or
+    HTTP code like ``503``), a client, a role (door/peer/serve)."""
+    out = []
+    for r in records:
+        if slow_ms is not None and r.get("duration_s", 0.0) * 1e3 < slow_ms:
+            continue
+        if status is not None and not (
+                str(r.get("status")) == status
+                or str(r.get("code")) == status):
+            continue
+        if client is not None and r.get("client") != client:
+            continue
+        if role is not None and r.get("role") != role:
+            continue
+        out.append(r)
+    return out
+
+
+def aggregate_requests(records: Iterable[Dict],
+                       slowest: int = 5) -> Dict:
+    """One summary over a record set: counts by status/tier/role,
+    latency p50/p99/max (via the bounded histogram), total bytes, and
+    the slowest records (each carrying its trace id — the page →
+    record → trace pivot)."""
+    records = list(records)
+    by_status: Dict[str, int] = {}
+    by_tier: Dict[str, int] = {}
+    by_role: Dict[str, int] = {}
+    lat = HistogramStats()
+    total_bytes = 0
+    hedges = hedge_wins = 0
+    for r in records:
+        by_status[str(r.get("status"))] = (
+            by_status.get(str(r.get("status")), 0) + 1)
+        if r.get("tier"):
+            by_tier[str(r["tier"])] = by_tier.get(str(r["tier"]), 0) + 1
+        by_role[str(r.get("role"))] = by_role.get(str(r.get("role")), 0) + 1
+        lat.observe(float(r.get("duration_s", 0.0)))
+        total_bytes += int(r.get("bytes", 0) or 0)
+        if r.get("hedged"):
+            hedges += 1
+            if r.get("hedge_won"):
+                hedge_wins += 1
+    slow = sorted(records, key=lambda r: r.get("duration_s", 0.0),
+                  reverse=True)[:max(0, int(slowest))]
+    return {
+        "records": len(records),
+        "by_status": by_status,
+        "by_tier": by_tier,
+        "by_role": by_role,
+        "p50_s": round(lat.percentile(0.50), 6),
+        "p99_s": round(lat.percentile(0.99), 6),
+        "max_s": round(lat.vmax, 6),
+        "bytes": total_bytes,
+        "hedged": hedges,
+        "hedge_won": hedge_wins,
+        "slowest": [
+            {k: r.get(k) for k in ("t", "rid", "trace", "role", "client",
+                                   "fp", "tier", "peer", "status",
+                                   "duration_s") if r.get(k) is not None}
+            for r in slow
+        ],
+    }
+
+
+def render_requests(records: Iterable[Dict]) -> str:
+    """Access records as a readable table (`blit requests`' default)."""
+    lines = [f"{'when':<8} {'role':<5} {'status':<10} {'tier':<9} "
+             f"{'ms':>9} {'client':<10} {'peer':<8} trace"]
+    for r in records:
+        when = time.strftime("%H:%M:%S", time.gmtime(r.get("t", 0.0)))
+        lines.append(
+            f"{when:<8} {str(r.get('role', '-')):<5} "
+            f"{str(r.get('status', '-')):<10} "
+            f"{str(r.get('tier') or '-'):<9} "
+            f"{r.get('duration_s', 0.0) * 1e3:>9.2f} "
+            f"{str(r.get('client', '-')):<10} "
+            f"{str(r.get('peer') or '-'):<8} {r.get('trace', '-')}")
+    return "\n".join(lines)
+
+
+# -- fleet trace gathering (ISSUE 15 tentpole #4) ----------------------------
+
+
+def gather_trace_sources(sources: Iterable[str], *,
+                         timeout: float = 10.0
+                         ) -> Tuple[List[Dict], Dict[str, HistogramStats]]:
+    """Span dicts + merged histograms from heterogeneous fleet sources
+    — what ``blit trace-view --fleet`` stitches.  Each source is:
+
+    - an ``http://...`` base URL → its ``/snapshot`` body (a peer/door
+      :class:`~blit.serve.http.PeerServer` or monitor endpoint);
+    - a directory → every ``*.jsonl`` monitor-spool file in it (span
+      batches per sample, newest cumulative timeline per process) plus
+      every ``*.snapshot.json`` saved snapshot;
+    - a ``.jsonl`` file → one spool file;
+    - any other file → a saved snapshot / fleet report / flight dump
+      (anything carrying ``spans`` and optionally a timeline).
+
+    Returns ``(spans, hists)`` with hists merged across processes
+    (exemplars keep the newest per bucket)."""
+    spans: List[Dict] = []
+    hists: Dict[str, HistogramStats] = {}
+
+    def fold_hists(hist_states: Optional[Dict]) -> None:
+        for name, st in (hist_states or {}).items():
+            if not isinstance(st, dict):
+                continue
+            h = HistogramStats.from_state(st)
+            if name in hists:
+                hists[name].merge(h)
+            else:
+                hists[name] = h
+
+    def fold_doc(doc: Dict) -> None:
+        if not isinstance(doc, dict):
+            return
+        spans.extend(s for s in (doc.get("spans") or [])
+                     if isinstance(s, dict))
+        tl = doc.get("timeline")
+        if isinstance(tl, dict):
+            fold_hists(tl.get("hists"))
+        fold_hists(doc.get("hists"))
+        # A merge_fleet report: per-host raw hist_state blocks.
+        for e in (doc.get("hosts") or {}).values():
+            if isinstance(e, dict):
+                fold_hists(e.get("hist_state"))
+
+    def fold_spool_file(path: str) -> None:
+        last_tl: Optional[Dict] = None
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        sample = json.loads(line)
+                    except ValueError:
+                        continue
+                    if not isinstance(sample, dict):
+                        continue
+                    spans.extend(s for s in (sample.get("spans") or [])
+                                 if isinstance(s, dict))
+                    if isinstance(sample.get("timeline"), dict):
+                        last_tl = sample["timeline"]
+        except OSError:
+            return
+        if last_tl:
+            fold_hists(last_tl.get("hists"))
+
+    for src in sources:
+        if src.startswith("http://") or src.startswith("https://"):
+            from blit.serve.http import http_json
+
+            try:
+                status, _, body = http_json(
+                    "GET", src.rstrip("/"), "/snapshot", timeout=timeout)
+            except OSError as e:
+                log.warning("trace source %s unreachable: %s", src, e)
+                continue
+            if status == 200 and isinstance(body, dict):
+                fold_doc(body)
+        elif os.path.isdir(src):
+            for path in sorted(glob.glob(os.path.join(src, "*.jsonl"))):
+                fold_spool_file(path)
+            for path in sorted(glob.glob(
+                    os.path.join(src, "*.snapshot.json"))):
+                try:
+                    with open(path) as f:
+                        fold_doc(json.load(f))
+                except (OSError, ValueError):
+                    continue
+        elif src.endswith(".jsonl"):
+            fold_spool_file(src)
+        else:
+            try:
+                with open(src) as f:
+                    fold_doc(json.load(f))
+            except (OSError, ValueError) as e:
+                log.warning("trace source %s unreadable: %s", src, e)
+    # Dedupe by span id (a /snapshot and a spool may overlap).
+    seen, unique = set(), []
+    for s in spans:
+        sid = s.get("span")
+        if sid and sid in seen:
+            continue
+        if sid:
+            seen.add(sid)
+        unique.append(s)
+    return unique, hists
 
 
 # -- bench-diff: the CI perf-regression gate --------------------------------

@@ -38,7 +38,7 @@ import threading
 import time
 from collections import defaultdict, deque
 from dataclasses import dataclass, field
-from typing import Dict, Iterable, Iterator, List, Optional
+from typing import Dict, Iterable, Iterator, List, Optional, Tuple
 
 log = logging.getLogger("blit.observability")
 
@@ -105,6 +105,26 @@ _HIST_BASE = 1e-6
 _HIST_NBUCKETS = 64
 _LOG2 = math.log(2.0)
 
+# Histogram exemplars (ISSUE 15 tentpole #3): when enabled, every
+# histogram retains the most recent trace id per bucket, so a p99 bucket
+# that pages an SLO resolves to an actual request's trace instead of an
+# anonymous count.  Bounded by construction (one (trace, value, t)
+# triple per non-empty bucket, 64 buckets).  BLIT_EXEMPLARS=0 is the
+# kill switch (the BLIT_SPANS discipline); SiteConfig.exemplars reaches
+# here through blit.config.request_log_defaults + set_exemplars().
+_EXEMPLARS = os.environ.get("BLIT_EXEMPLARS", "1").lower() not in (
+    "0", "false", "off", "")
+
+
+def set_exemplars(enabled: bool) -> None:
+    """Flip per-bucket trace-id exemplar retention process-wide."""
+    global _EXEMPLARS
+    _EXEMPLARS = bool(enabled)
+
+
+def exemplars_enabled() -> bool:
+    return _EXEMPLARS
+
 
 def hist_bucket_edges() -> List[float]:
     """The UPPER edge of every histogram bucket, in order: bucket 0
@@ -123,7 +143,7 @@ class HistogramStats:
     (ISSUE 5 tentpole #2).  Exact ``min``/``max``/``sum`` ride along so the
     tail operators page on (``max``) is never a bucket estimate."""
 
-    __slots__ = ("counts", "n", "total", "vmin", "vmax")
+    __slots__ = ("counts", "n", "total", "vmin", "vmax", "exemplars")
 
     def __init__(self):
         self.counts = [0] * _HIST_NBUCKETS
@@ -131,8 +151,11 @@ class HistogramStats:
         self.total = 0.0
         self.vmin = 0.0
         self.vmax = 0.0
+        # bucket index -> [trace_id, value, epoch seconds] of the most
+        # recent exemplar landing there; None until one lands (ISSUE 15).
+        self.exemplars: Optional[Dict[int, List]] = None
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, trace_id: Optional[str] = None) -> None:
         v = float(value)
         if v <= _HIST_BASE:
             i = 0
@@ -147,6 +170,29 @@ class HistogramStats:
             self.vmax = max(self.vmax, v)
         self.n += 1
         self.total += v
+        if trace_id is None and _EXEMPLARS:
+            # The ambient trace (thread-local read — cheap, and only
+            # when a span is actually active): the sample becomes that
+            # trace's exemplar in its latency bucket.
+            ctx = _TRACER.context()
+            if ctx:
+                trace_id = ctx["trace"]
+        if trace_id:
+            ex = self.exemplars
+            if ex is None:
+                ex = self.exemplars = {}
+            ex[i] = [trace_id, v, time.time()]
+
+    def tail_exemplar(self) -> Optional[Dict]:
+        """The exemplar of the HIGHEST bucket that has one — the trace
+        behind the tail latency an operator is chasing.  Returns
+        ``{"bucket", "le", "trace", "value", "t"}`` or None."""
+        if not self.exemplars:
+            return None
+        i = max(self.exemplars)
+        trace, v, t = self.exemplars[i]
+        return {"bucket": i, "le": _HIST_BASE * 2.0 ** i,
+                "trace": trace, "value": v, "t": t}
 
     def percentile(self, p: float) -> float:
         """Quantile estimate (0.0 when empty): the midpoint of the bucket
@@ -179,6 +225,15 @@ class HistogramStats:
                 self.counts[i] += c
         self.n += other.n
         self.total += other.total
+        if other.exemplars:
+            # "Most recent per bucket" stays true across the fold: the
+            # newer timestamp wins, whichever process observed it.
+            ex = self.exemplars
+            if ex is None:
+                ex = self.exemplars = {}
+            for i, rec in other.exemplars.items():
+                if i not in ex or rec[2] >= ex[i][2]:
+                    ex[i] = list(rec)
         return self
 
     def reset(self) -> None:
@@ -188,6 +243,7 @@ class HistogramStats:
         self.n = 0
         self.total = 0.0
         self.vmin = self.vmax = 0.0
+        self.exemplars = None
 
     def report(self) -> Dict[str, float]:
         mean = self.total / self.n if self.n else 0.0
@@ -200,8 +256,13 @@ class HistogramStats:
     def state(self) -> Dict:
         """JSON-serializable raw state (the harvest wire format — reports
         round, state doesn't, so fleet merges stay exact)."""
-        return {"counts": list(self.counts), "n": self.n,
-                "total": self.total, "vmin": self.vmin, "vmax": self.vmax}
+        st = {"counts": list(self.counts), "n": self.n,
+              "total": self.total, "vmin": self.vmin, "vmax": self.vmax}
+        if self.exemplars:
+            # JSON keys are strings; from_state re-ints them.
+            st["exemplars"] = {str(i): list(rec)
+                               for i, rec in self.exemplars.items()}
+        return st
 
     def since(self, st: Dict) -> "HistogramStats":
         """A NEW histogram holding only the samples observed after ``st``
@@ -217,6 +278,12 @@ class HistogramStats:
         h.n = max(0, self.n - int(st.get("n", 0)))
         h.total = max(0.0, self.total - float(st.get("total", 0.0)))
         h.vmin, h.vmax = self.vmin, self.vmax
+        if self.exemplars:
+            # Exemplars are "most recent", not a running total: the
+            # delta keeps the cumulative ones (a tail sample in this
+            # window overwrote its bucket's entry anyway).
+            h.exemplars = {i: list(rec)
+                           for i, rec in self.exemplars.items()}
         return h
 
     @classmethod
@@ -228,6 +295,15 @@ class HistogramStats:
         h.total = float(st.get("total", 0.0))
         h.vmin = float(st.get("vmin", 0.0))
         h.vmax = float(st.get("vmax", 0.0))
+        for i, rec in (st.get("exemplars") or {}).items():
+            try:
+                bucket = int(i)
+                trace, v, t = rec
+            except (TypeError, ValueError):
+                continue
+            if h.exemplars is None:
+                h.exemplars = {}
+            h.exemplars[bucket] = [str(trace), float(v), float(t)]
         return h
 
 
@@ -567,6 +643,14 @@ class Tracer:
                 "0", "false", "off", "")
         self.enabled = enabled
         self._spans: deque = deque(maxlen=max_spans)
+        # Monotonic count of spans EVER recorded — the cursor behind
+        # spans_since(), so interval publishers ship each span once
+        # without draining the deque out from under export_chrome.
+        # The (append, += 1) pair is guarded: `+= 1` alone is not
+        # atomic, and a lost increment would silently drop the tail of
+        # a spool batch.
+        self._total = 0
+        self._span_lock = threading.Lock()
         self._tls = threading.local()
 
     def _stack(self) -> List:
@@ -596,7 +680,9 @@ class Tracer:
         finally:
             sp.duration_s = time.perf_counter() - p0
             stack.pop()
-            self._spans.append(sp)
+            with self._span_lock:
+                self._spans.append(sp)
+                self._total += 1
             _FLIGHT.span_event(sp)
 
     @contextlib.contextmanager
@@ -631,14 +717,34 @@ class Tracer:
     def span_dicts(self) -> List[Dict]:
         return [s.as_dict() for s in self._spans]
 
+    def spans_since(self, cursor: int) -> Tuple[int, List[Dict]]:
+        """Span dicts recorded after a prior cursor → ``(new cursor,
+        spans)`` — the interval publisher's batch surface (ISSUE 15
+        tentpole #4): each tick ships only the spans finished since the
+        last one, so a spool line stays proportional to the interval,
+        not the run.  Spans that aged out of the bounded deque between
+        slow ticks are lost (by design — the deque bounds memory)."""
+        with self._span_lock:
+            total = self._total
+            new = total - int(cursor)
+            if new <= 0:
+                return total, []
+            recent = list(self._spans)
+        if new < len(recent):
+            recent = recent[-new:]
+        return total, [s.as_dict() for s in recent]
+
     def ingest(self, span_dicts: Iterable[Dict]) -> None:
         """Adopt foreign spans (a fleet harvest) into this tracer so one
         :meth:`export_chrome` covers driver and workers."""
         for d in span_dicts:
             try:
-                self._spans.append(Span.from_dict(d))
+                sp = Span.from_dict(d)
             except (TypeError, ValueError):  # malformed harvest entry
                 continue
+            with self._span_lock:
+                self._spans.append(sp)
+                self._total += 1
 
     def reset(self) -> None:
         self._spans.clear()
@@ -702,6 +808,13 @@ def span(name: str, **attrs):
     return _TRACER.span(name, **attrs)
 
 
+def new_id() -> str:
+    """A fresh process-unique id in the span-id format — request ids
+    (:class:`RequestLog`) share the spans' id space so a record, a span
+    and a log line are all greppable by the same token."""
+    return _new_id()
+
+
 # -- flight recorder --------------------------------------------------------
 
 
@@ -714,11 +827,27 @@ class FlightRecorder:
     one incident file, not hundreds.  ``python -m blit trace-view``
     renders a dump into an incident summary."""
 
+    # Bound on distinct rate-limit clocks (ISSUE 15 satellite): reasons
+    # carry per-instance detail, so the keyed dict must not grow without
+    # bound under adversarial reason churn.
+    _MAX_DUMP_KEYS = 64
+
     def __init__(self, capacity: int = 512, min_interval_s: float = 60.0):
         self._ring: deque = deque(maxlen=capacity)
         self.min_interval_s = min_interval_s
-        self._last_dump = float("-inf")
+        # Rate limiting is PER REASON CLASS (ISSUE 15 satellite), not
+        # one global clock: an SLO-breach dump must not starve a
+        # first-of-kind stall dump that lands seconds later.  Keys are
+        # the reason's leading "name" segment (before the first ":" or
+        # "—"), or an explicit dump(key=...).
+        self._last_dump: Dict[str, float] = {}
+        self._dump_seq = 0
         self._dump_lock = threading.Lock()
+
+    @staticmethod
+    def _reason_key(reason: str) -> str:
+        head = reason.split("—", 1)[0].split(":", 1)[0].strip()
+        return head[:64] or "dump"
 
     # -- recording (hot paths) --------------------------------------------
     def event(self, kind: str, name: str, **fields) -> None:
@@ -744,20 +873,30 @@ class FlightRecorder:
 
     # -- dumping (incident path) ------------------------------------------
     def dump(self, reason: str, path: Optional[str] = None,
-             force: bool = False) -> Optional[str]:
+             force: bool = False, key: Optional[str] = None) -> Optional[str]:
         """Write the incident JSON (ring + fault counters + process
         timeline + recent spans) and return its path.  Never raises (the
         caller is already mid-incident); returns None when rate-limited
         (``force=True`` overrides) or when ``BLIT_FLIGHT_DISABLE`` is
-        set."""
+        set.  The rate limit is per reason CLASS (``key``, default the
+        reason's leading name segment) — distinct incident kinds never
+        starve each other (ISSUE 15 satellite)."""
         if os.environ.get("BLIT_FLIGHT_DISABLE"):
             return None
         try:
             now = time.monotonic()
+            k = key if key is not None else self._reason_key(reason)
             with self._dump_lock:
-                if not force and now - self._last_dump < self.min_interval_s:
+                last = self._last_dump.get(k, float("-inf"))
+                if not force and now - last < self.min_interval_s:
                     return None
-                self._last_dump = now
+                if (k not in self._last_dump
+                        and len(self._last_dump) >= self._MAX_DUMP_KEYS):
+                    # Evict the stalest clock: new incident kinds keep
+                    # their own limiter without unbounded growth.
+                    self._last_dump.pop(
+                        min(self._last_dump, key=self._last_dump.get))
+                self._last_dump[k] = now
             from blit import faults
 
             doc = {
@@ -771,15 +910,30 @@ class FlightRecorder:
                 "timeline": process_timeline().report(),
                 "spans": [s.as_dict() for s in _TRACER.spans()[-64:]],
             }
+            # Correlate the incident with the request that tripped it
+            # (ISSUE 15 satellite): when a span is active on the dumping
+            # thread, its trace/span ids land in the dump — a flight
+            # record and a stitched fleet trace become greppable by one
+            # token.
+            ctx = _TRACER.context()
+            if ctx:
+                doc["trace"] = ctx.get("trace")
+                doc["span"] = ctx.get("span")
             if path is None:
                 d = os.environ.get("BLIT_FLIGHT_DIR")
                 if not d:
                     import tempfile
 
                     d = tempfile.gettempdir()
+                # The per-process sequence number keeps two same-second
+                # dumps (now possible: rate limiting is per REASON) from
+                # overwriting each other's file.
+                with self._dump_lock:
+                    self._dump_seq += 1
+                    seq = self._dump_seq
                 path = os.path.join(
                     d, f"blit-flight-{hostname()}-{os.getpid()}-"
-                       f"{int(doc['t'])}.json")
+                       f"{int(doc['t'])}-{seq}.json")
             tmp = path + ".tmp"
             with open(tmp, "w") as f:
                 json.dump(doc, f)
@@ -878,6 +1032,11 @@ def render_flight_dump(doc: Dict, tail: int = 40) -> str:
     lines.append(f"where  : {doc.get('host', '?')}/w{doc.get('worker', 0)} "
                  f"pid {doc.get('pid', '?')}")
     lines.append(f"when   : {when} UTC")
+    if doc.get("trace"):
+        # The ambient trace at dump time (ISSUE 15): follow it into the
+        # stitched fleet trace (`blit trace-view --fleet ... --trace`).
+        lines.append(f"trace  : {doc['trace']} "
+                     f"(span {doc.get('span', '?')})")
     faults_c = doc.get("faults") or {}
     if faults_c:
         lines.append("fault counters:")
@@ -903,6 +1062,185 @@ def render_flight_dump(doc: Dict, tail: int = 40) -> str:
                 if k not in ("t", "kind", "name")}
         detail = " ".join(f"{k}={v}" for k, v in rest.items())
         lines.append(f"  {ts} [{kind:<5}] {name} {detail}".rstrip())
+    return "\n".join(lines)
+
+
+# -- per-request access records (ISSUE 15 tentpole #2) -----------------------
+
+
+class RequestLog:
+    """A bounded JSON-lines log of per-request access records — the
+    serving planes' flight-data recorder for REQUESTS: one line per
+    request with request/trace id, fingerprint, client, priority,
+    deadline remaining, tier outcome, queue wait, routed peer, hedge
+    outcome, bytes and status (`python -m blit requests` tails,
+    filters and aggregates a spool of these).
+
+    Bounded by SIZE ROTATION: when the live file passes ``max_bytes``
+    it rotates to ``<path>.1`` .. ``<path>.<max_files-1>`` and the
+    oldest rolls off — a busy front door's log occupies
+    ``max_bytes * max_files`` at most, forever.  Appends are one
+    ``json.dumps`` + write under a lock; :meth:`record` never raises
+    (access logging must not fail a request)."""
+
+    def __init__(self, path: str, *, max_bytes: int = 8 << 20,
+                 max_files: int = 4):
+        self.path = path
+        self.max_bytes = max(4096, int(max_bytes))
+        self.max_files = max(1, int(max_files))
+        self._lock = threading.Lock()
+        self._f = None
+        self._size = 0
+
+    def _open(self) -> None:
+        d = os.path.dirname(self.path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._f = open(self.path, "a")
+        self._size = self._f.tell()
+
+    def _rotate_locked(self) -> None:
+        self._f.close()
+        self._f = None
+        if self.max_files == 1:
+            os.remove(self.path)  # a one-file budget truncates in place
+        else:
+            for i in range(self.max_files - 1, 0, -1):
+                src = self.path if i == 1 else f"{self.path}.{i - 1}"
+                dst = f"{self.path}.{i}"
+                if os.path.exists(src):
+                    os.replace(src, dst)
+        self._open()
+
+    def record(self, **fields) -> None:
+        """Append one access record (a ``t`` timestamp is stamped in;
+        None-valued fields are dropped so lines stay compact)."""
+        try:
+            doc = {"t": round(time.time(), 6)}
+            doc.update({k: v for k, v in fields.items() if v is not None})
+            line = json.dumps(doc) + "\n"
+            with self._lock:
+                if self._f is None:
+                    self._open()
+                self._f.write(line)
+                self._f.flush()
+                self._size += len(line)
+                if self._size >= self.max_bytes:
+                    self._rotate_locked()
+        except Exception:  # noqa: BLE001 — logging must not fail requests
+            log.warning("request log append failed", exc_info=True)
+
+    def files(self) -> List[str]:
+        """Every rotation member that exists, oldest first."""
+        out = [f"{self.path}.{i}"
+               for i in range(self.max_files - 1, 0, -1)
+               if os.path.exists(f"{self.path}.{i}")]
+        if os.path.exists(self.path):
+            out.append(self.path)
+        return out
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                with contextlib.suppress(OSError):
+                    self._f.close()
+                self._f = None
+
+
+def request_log_for(role: str, config=None) -> Optional[RequestLog]:
+    """The configured :class:`RequestLog` for a serving component
+    (``role`` names it in the spool: ``requests-<role>-<host>-<pid>``),
+    or None when request logging is disabled — the disabled path is one
+    dict lookup per request (:func:`blit.config.request_log_defaults`:
+    ``BLIT_REQUEST_LOG`` / ``SiteConfig.request_log_dir``).
+
+    Also applies the config's ``exemplars`` knob (process-wide — every
+    serving component constructs through here, so a peer/service-only
+    process honors ``SiteConfig.exemplars=False`` exactly like a door;
+    last constructor wins when configs disagree in one process)."""
+    from blit.config import DEFAULT, request_log_defaults
+
+    d = request_log_defaults(DEFAULT if config is None else config)
+    set_exemplars(d["exemplars"])
+    if not d["dir"]:
+        return None
+    path = os.path.join(
+        d["dir"], f"requests-{role}-{hostname()}-{os.getpid()}.jsonl")
+    return RequestLog(path, max_bytes=d["max_bytes"],
+                      max_files=d["files"])
+
+
+# -- fleet trace stitching (ISSUE 15 tentpole #4) ----------------------------
+
+
+def span_process(span_id: str) -> str:
+    """The process prefix of a span/trace id (everything before the
+    counter): ids are minted as ``<pid-hex + 2 random bytes>.<n>``, so
+    two spans share a prefix iff one process recorded them."""
+    return str(span_id).split(".", 1)[0]
+
+
+def cross_process_pairs(span_dicts: Iterable[Dict]) -> int:
+    """How many parent→child span edges CROSS a process boundary — the
+    stitched-trace acceptance metric (ISSUE 15): a fleet request whose
+    peer-side spans parent onto the front-door span contributes at
+    least one."""
+    spans = list(span_dicts)
+    by_id = {s.get("span"): s for s in spans if s.get("span")}
+    pairs = 0
+    for s in spans:
+        parent = s.get("parent")
+        if not parent or parent not in by_id:
+            continue
+        if span_process(parent) != span_process(s.get("span", "")):
+            pairs += 1
+    return pairs
+
+
+def trace_summary(span_dicts: Iterable[Dict]) -> Dict:
+    """Shape of a stitched span set: totals, distinct traces/processes,
+    and the cross-process edge count."""
+    spans = list(span_dicts)
+    traces = {s.get("trace") for s in spans if s.get("trace")}
+    procs = {span_process(s.get("span", "")) for s in spans
+             if s.get("span")}
+    return {"spans": len(spans), "traces": len(traces),
+            "processes": len(procs),
+            "cross_process_pairs": cross_process_pairs(spans)}
+
+
+def render_trace_tree(span_dicts: Iterable[Dict], trace_id: str,
+                      max_spans: int = 200) -> str:
+    """One trace as an indented parent→child tree (the ``blit
+    trace-view --fleet --trace`` body): every span's name, duration,
+    host/process and hedge tag, children under parents, orphans (their
+    parent aged out of a bounded buffer) at the root."""
+    spans = [s for s in span_dicts if s.get("trace") == trace_id]
+    spans.sort(key=lambda s: s.get("t0", 0.0))
+    spans = spans[:max_spans]
+    ids = {s.get("span") for s in spans}
+    children: Dict[str, List[Dict]] = {}
+    roots: List[Dict] = []
+    for s in spans:
+        parent = s.get("parent")
+        if parent and parent in ids:
+            children.setdefault(parent, []).append(s)
+        else:
+            roots.append(s)
+    lines = [f"trace {trace_id}: {len(spans)} span(s)"]
+
+    def walk(s: Dict, depth: int) -> None:
+        attrs = s.get("attrs") or {}
+        tag = " hedge=1" if attrs.get("hedge") else ""
+        where = f"{s.get('host', '?')}/{span_process(s.get('span', ''))}"
+        lines.append(
+            f"  {'  ' * depth}{s.get('name', '?'):<24} "
+            f"{s.get('duration_s', 0.0) * 1e3:9.3f} ms  [{where}]{tag}")
+        for c in children.get(s.get("span"), []):
+            walk(c, depth + 1)
+
+    for r in roots:
+        walk(r, 0)
     return "\n".join(lines)
 
 
@@ -1032,7 +1370,21 @@ def prom_escape(value) -> str:
             .replace('"', '\\"'))
 
 
-def render_prometheus(report: Dict) -> str:
+# The two exposition content types a /metrics endpoint can answer with:
+# exemplars are only legal in the OpenMetrics format, so the servers
+# negotiate via the Accept header (the prometheus_client discipline) —
+# a legacy text-format scrape must never see an exemplar suffix its
+# parser would reject.
+PROM_CTYPE = "text/plain; version=0.0.4"
+OPENMETRICS_CTYPE = "application/openmetrics-text; version=1.0.0; charset=utf-8"
+
+
+def wants_openmetrics(accept: Optional[str]) -> bool:
+    """Did the scraper negotiate OpenMetrics (exemplar-capable)?"""
+    return bool(accept) and "application/openmetrics-text" in accept
+
+
+def render_prometheus(report: Dict, *, openmetrics: bool = False) -> str:
     """A fleet report (:func:`merge_fleet`) in Prometheus exposition
     format — one scrape body with host-labelled stage/gauge/histogram/
     fault series (the ``python -m blit telemetry --format prom`` output
@@ -1045,7 +1397,12 @@ def render_prometheus(report: Dict) -> str:
     carries — so a real Prometheus server computes any quantile over any
     window, instead of scraping our precomputed p50/p90/p99 (which still
     ride along as ``blit_latency_quantile`` gauges, and are all a saved
-    legacy report without raw state can offer)."""
+    legacy report without raw state can offer).
+
+    ``openmetrics=True`` (the Accept-negotiated mode, ISSUE 15) adds
+    per-bucket trace-id EXEMPLARS in OpenMetrics exemplar syntax and the
+    ``# EOF`` trailer; the default text format stays exemplar-free —
+    the legacy Prometheus text parser rejects the suffix."""
     lines: List[str] = []
 
     def head(metric: str, mtype: str, help_: str) -> None:
@@ -1083,14 +1440,26 @@ def render_prometheus(report: Dict) -> str:
             nl = prom_escape(k)
             st = hist_state.get(k)
             if st:
+                exemplars = st.get("exemplars") or {}
                 acc = 0
                 for i, c in enumerate(st.get("counts") or []):
                     if not c:
                         continue
                     acc += int(c)
-                    lines.append(
+                    line = (
                         f'blit_latency_seconds_bucket{{host="{hl}",'
                         f'name="{nl}",le="{edges[i]:.10g}"}} {acc}')
+                    ex = (exemplars.get(str(i)) or exemplars.get(i)
+                          if openmetrics else None)
+                    if ex:
+                        # OpenMetrics exemplar syntax (ISSUE 15): the
+                        # most recent trace id that landed in this
+                        # bucket, so a dashboard's tail bucket links
+                        # straight to a stitched trace.
+                        trace, v, t = ex
+                        line += (f' # {{trace_id="{prom_escape(trace)}"}}'
+                                 f' {float(v):.9g} {float(t):.3f}')
+                    lines.append(line)
                 lines.append(
                     f'blit_latency_seconds_bucket{{host="{hl}",'
                     f'name="{nl}",le="+Inf"}} {int(st.get("n", 0))}')
@@ -1108,6 +1477,8 @@ def render_prometheus(report: Dict) -> str:
             lines.append(
                 f'blit_fault_total{{host="{hl}",'
                 f'counter="{prom_escape(k)}"}} {v}')
+    if openmetrics:
+        lines.append("# EOF")
     return "\n".join(lines) + "\n"
 
 

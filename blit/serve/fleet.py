@@ -60,7 +60,7 @@ from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
 
-from blit import faults
+from blit import faults, observability
 from blit.config import DEFAULT, SiteConfig, fleet_defaults
 from blit.faults import CircuitBreaker
 from blit.observability import (
@@ -73,9 +73,11 @@ from blit.observability import (
     render_prometheus,
 )
 from blit.serve.http import (
+    TIER_HEADER,
     decode_product,
     http_json,
     retry_after_from,
+    trace_headers,
     wire_request,
 )
 from blit.serve.ring import HashRing
@@ -227,6 +229,14 @@ class FleetFrontDoor:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._last_health_fetch = 0.0
+        # Per-request access records (ISSUE 15 tentpole #2): the door
+        # writes exactly one line per request — served, refused at the
+        # drain latch, deadline-expired or failed — with the routing
+        # outcome a peer-side record cannot know (routed peer, hedge
+        # verdict).  None (one attribute test per request) unless
+        # BLIT_REQUEST_LOG / SiteConfig.request_log_dir is set.
+        # (request_log_for also applies the config's exemplars knob.)
+        self.request_log = observability.request_log_for("door", config)
 
     # -- liveness ----------------------------------------------------------
     def start(self) -> "FleetFrontDoor":
@@ -332,46 +342,103 @@ class FleetFrontDoor:
         over on refusal/death, propagate the deadline every hop.
         Raises :class:`~blit.serve.scheduler.Overloaded` /
         :class:`~blit.serve.scheduler.DeadlineExpired` /
-        :class:`FleetError` (every replica failed)."""
-        t0 = self.clock()
-        with self._lock:
-            if self._draining:
-                self.timeline.count("fleet.rejected")
-                raise Overloaded("front door is draining; retry against "
-                                 "the replacement", retry_after_s=1.0)
-            self._inflight += 1
-        try:
-            wire = wire_request(request, priority=priority, client=client,
-                                deadline_s=deadline_s)
-            from blit.serve.cache import fingerprint_for
+        :class:`FleetError` (every replica failed).
 
-            fp = fingerprint_for(request.reducer(), request.raw_source)
-            self.timeline.count("fleet.requests")
-            t_req = time.perf_counter()
-            header, data = self._fetch(fp, wire, t0, deadline_s)
-            self.timeline.observe("fleet.request_s",
-                                  time.perf_counter() - t_req)
-            self._note_hot(fp, wire["recipe"])
-            return header, data
+        The whole request runs inside a ``fleet.request`` span
+        (ISSUE 15): peer dispatches become child spans carried across
+        the wire, the hedge verdict lands on this span's attrs, and one
+        access record is written per call whatever the outcome."""
+        t0 = self.clock()
+        t_req = time.perf_counter()
+        rid = observability.new_id()
+        tr = observability.tracer()
+        status, code, fp, nbytes = "error", 500, None, 0
+        trace_id: Optional[str] = None
+        outcome: Dict = {}
+        try:
+            with tr.span("fleet.request", client=client) as sp:
+                if sp is not None:
+                    trace_id = sp.trace_id
+                with self._lock:
+                    if self._draining:
+                        self.timeline.count("fleet.rejected")
+                        raise Overloaded(
+                            "front door is draining; retry against "
+                            "the replacement", retry_after_s=1.0)
+                    self._inflight += 1
+                try:
+                    wire = wire_request(request, priority=priority,
+                                        client=client,
+                                        deadline_s=deadline_s)
+                    from blit.serve.cache import fingerprint_for
+
+                    fp = fingerprint_for(request.reducer(),
+                                         request.raw_source)
+                    self.timeline.count("fleet.requests")
+                    header, data = self._fetch(fp, wire, t0, deadline_s,
+                                               rid=rid, outcome=outcome)
+                    # Observed INSIDE the request span: the tail
+                    # bucket's exemplar is this request's trace id
+                    # (ISSUE 15 tentpole #3).
+                    self.timeline.observe("fleet.request_s",
+                                          time.perf_counter() - t_req)
+                    nbytes = data.nbytes
+                    status, code = "ok", 200
+                    if sp is not None:
+                        sp.attrs = dict(
+                            sp.attrs or {}, fp=fp[:16],
+                            **{k: v for k, v in outcome.items()
+                               if v is not None})
+                    self._note_hot(fp, wire["recipe"])
+                    return header, data
+                finally:
+                    with self._drain_cond:
+                        self._inflight -= 1
+                        self._drain_cond.notify_all()
+        except BaseException as e:
+            from blit.serve.scheduler import classify_failure
+
+            status, code = classify_failure(e)
+            raise
         finally:
-            with self._drain_cond:
-                self._inflight -= 1
-                self._drain_cond.notify_all()
+            if self.request_log is not None:
+                dt = time.perf_counter() - t_req
+                self.request_log.record(
+                    rid=rid, trace=trace_id,
+                    role="door", client=client, priority=priority,
+                    fp=(fp[:16] if fp else None),
+                    tier=outcome.get("tier"),
+                    peer=outcome.get("peer"),
+                    hedged=outcome.get("hedged"),
+                    hedge_won=outcome.get("hedge_won"),
+                    deadline_s=deadline_s,
+                    deadline_left_s=(round(deadline_s - dt, 6)
+                                     if deadline_s is not None else None),
+                    status=status, code=code, bytes=nbytes,
+                    duration_s=round(dt, 6))
 
     def targets_for(self, fp: str) -> List[_Peer]:
         return [self._peers[n] for n in self.ring.owners(fp)]
 
     def _fetch(self, fp: str, wire: Dict, t0: float,
-               deadline_s: Optional[float]) -> Tuple[Dict, np.ndarray]:
+               deadline_s: Optional[float], rid: Optional[str] = None,
+               outcome: Optional[Dict] = None
+               ) -> Tuple[Dict, np.ndarray]:
         targets = self.targets_for(fp)
         if not targets:
             raise FleetError("no live peers in the ring")
+        # The caller's ambient context (the fleet.request span): every
+        # dispatch thread reactivates it so its fleet.dispatch span — and
+        # the peer-side spans parented onto it across the wire — belong
+        # to THIS request's trace (ISSUE 15 tentpole #1).
+        ctx = observability.tracer().context()
         q: "queue.Queue" = queue.Queue()
         done = threading.Event()
 
         def run(p: _Peer, hedge: bool) -> None:
             try:
-                res = self._fetch_one(p, wire, fp, t0, deadline_s)
+                res = self._fetch_one(p, wire, fp, t0, deadline_s,
+                                      ctx=ctx, hedge=hedge, rid=rid)
                 ok = True
             except BaseException as e:  # noqa: BLE001 — delivered below
                 res, ok = e, False
@@ -456,7 +523,16 @@ class FleetFrontDoor:
                 done.set()
                 if was_hedge:
                     self.timeline.count("fleet.hedge.win")
-                return res
+                header, data, tier = res
+                if outcome is not None:
+                    # The routing verdict for the parent span + access
+                    # record: who answered, from which tier, and
+                    # whether the hedge won (ISSUE 15).
+                    outcome.update(peer=p.name, tier=tier,
+                                   hedged=1 if hedged else None,
+                                   hedge_won=(1 if was_hedge else 0)
+                                   if hedged else None)
+                return header, data
             last_exc = res
             rem = self._remaining(t0, deadline_s)
             if isinstance(res, DeadlineExpired) and (rem is None
@@ -504,38 +580,52 @@ class FleetFrontDoor:
         return tripped
 
     def _fetch_one(self, p: _Peer, wire: Dict, fp: str, t0: float,
-                   deadline_s: Optional[float]
-                   ) -> Tuple[Dict, np.ndarray]:
-        """One peer round-trip, with the remaining deadline propagated
-        ON THE WIRE (the peer's scheduler re-checks it at admission and
-        dispatch) and the live latency histogram fed either way."""
-        faults.fire("fleet.route", key=p.name)
-        doc = dict(wire)
-        rem = self._remaining(t0, deadline_s)
-        if rem is not None:
-            doc["deadline_s"] = max(0.0, rem)
-        p.requests += 1
-        self.timeline.count("fleet.route")
-        t = time.perf_counter()
-        try:
-            status, hdrs, body = http_json(
-                "POST", p.url, "/product", doc,
-                timeout=self._fetch_timeout(t0, deadline_s))
-        finally:
-            dt = time.perf_counter() - t
-            p.hist.observe(dt)
-            self.timeline.observe("fleet.peer_s", dt)
-        if status == 200:
-            p.breaker.record_success()
-            return decode_product(body)
-        msg = (body.get("error") if isinstance(body, dict)
-               else str(body)[:200])
-        if status == 503:
-            raise Overloaded(f"peer {p.name}: {msg}",
-                             retry_after_s=retry_after_from(hdrs, body))
-        if status == 504:
-            raise DeadlineExpired(f"peer {p.name}: {msg}")
-        raise PeerHTTPError(f"peer {p.name} answered HTTP {status}: {msg}")
+                   deadline_s: Optional[float], ctx: Optional[Dict] = None,
+                   hedge: bool = False, rid: Optional[str] = None
+                   ) -> Tuple[Dict, np.ndarray, Optional[str]]:
+        """One peer round-trip → ``(header, data, tier)`` with the
+        remaining deadline propagated ON THE WIRE (the peer's scheduler
+        re-checks it at admission and dispatch), the live latency
+        histogram fed either way, and the trace context carried as
+        headers (ISSUE 15): the dispatch runs in its own
+        ``fleet.dispatch`` span — hedges are sibling spans tagged
+        ``hedge=1`` — whose context the peer reactivates, so peer-side
+        spans parent onto this request across the process boundary."""
+        tr = observability.tracer()
+        with tr.activate(ctx), \
+                tr.span("fleet.dispatch", peer=p.name,
+                        hedge=1 if hedge else 0):
+            faults.fire("fleet.route", key=p.name)
+            doc = dict(wire)
+            rem = self._remaining(t0, deadline_s)
+            if rem is not None:
+                doc["deadline_s"] = max(0.0, rem)
+            p.requests += 1
+            self.timeline.count("fleet.route")
+            t = time.perf_counter()
+            try:
+                status, hdrs, body = http_json(
+                    "POST", p.url, "/product", doc,
+                    timeout=self._fetch_timeout(t0, deadline_s),
+                    headers=trace_headers(hedge=hedge, rid=rid))
+            finally:
+                dt = time.perf_counter() - t
+                p.hist.observe(dt)
+                self.timeline.observe("fleet.peer_s", dt)
+            if status == 200:
+                p.breaker.record_success()
+                header, data = decode_product(body)
+                return header, data, hdrs.get(TIER_HEADER.lower())
+            msg = (body.get("error") if isinstance(body, dict)
+                   else str(body)[:200])
+            if status == 503:
+                raise Overloaded(
+                    f"peer {p.name}: {msg}",
+                    retry_after_s=retry_after_from(hdrs, body))
+            if status == 504:
+                raise DeadlineExpired(f"peer {p.name}: {msg}")
+            raise PeerHTTPError(
+                f"peer {p.name} answered HTTP {status}: {msg}")
 
     # -- cache-warm replication --------------------------------------------
     def _note_hot(self, fp: str, recipe: Dict) -> None:
@@ -557,16 +647,24 @@ class FleetFrontDoor:
             self.timeline.count("fleet.warm")
             threading.Thread(
                 target=self._send_warm,
-                args=([self._peers[n] for n in replicas], [recipe]),
+                args=([self._peers[n] for n in replicas], [recipe],
+                      observability.tracer().context()),
                 name="blit-fleet-warm", daemon=True).start()
 
-    def _send_warm(self, peers: List[_Peer], recipes: List[Dict]) -> None:
-        for p in peers:
-            try:
-                http_json("POST", p.url, "/warm", {"recipes": recipes},
-                          timeout=5.0)
-            except OSError:
-                pass  # warming is best-effort by definition
+    def _send_warm(self, peers: List[_Peer], recipes: List[Dict],
+                   ctx: Optional[Dict] = None) -> None:
+        # Warm hints carry the hot request's trace (ISSUE 15): the
+        # replication work a request triggers stays attributable to it.
+        tr = observability.tracer()
+        with tr.activate(ctx), tr.span("fleet.warm", peers=len(peers)):
+            hdrs = trace_headers()
+            for p in peers:
+                try:
+                    http_json("POST", p.url, "/warm",
+                              {"recipes": recipes}, timeout=5.0,
+                              headers=hdrs)
+                except OSError:
+                    pass  # warming is best-effort by definition
 
     # -- surfaces ----------------------------------------------------------
     def health(self) -> Dict:
@@ -619,11 +717,12 @@ class FleetFrontDoor:
                       if k in FLEET_HISTS},
         }
 
-    def metrics_prometheus(self) -> str:
+    def metrics_prometheus(self, openmetrics: bool = False) -> str:
         snapshot = {"host": hostname(), "pid": os.getpid(), "worker": 0,
                     "timeline": self.timeline.state(),
                     "faults": faults.counters(), "spans": []}
-        return render_prometheus(merge_fleet([snapshot]))
+        return render_prometheus(merge_fleet([snapshot]),
+                                 openmetrics=openmetrics)
 
     # -- drain / teardown --------------------------------------------------
     def drain(self, timeout: Optional[float] = 30.0,
@@ -669,6 +768,8 @@ class FleetFrontDoor:
         if self._thread is not None:
             self._thread.join(timeout=2.0)
             self._thread = None
+        if self.request_log is not None:
+            self.request_log.close()
 
     def __enter__(self):
         return self.start()

@@ -47,10 +47,55 @@ from typing import Callable, Dict, Optional, Tuple
 
 import numpy as np
 
-from blit import faults
+from blit import faults, observability
+from blit.config import DEFAULT, SiteConfig
 from blit.serve.scheduler import DeadlineExpired, Overloaded
 
 log = logging.getLogger("blit.serve.http")
+
+
+# -- trace context on the wire (ISSUE 15 tentpole #1) ------------------------
+#
+# Every fleet HTTP hop carries the PR-5 trace context as headers, so the
+# receiving process reactivates the caller's context and its spans
+# parent onto the caller's — one request, one trace, across processes.
+TRACE_HEADER = "X-Blit-Trace"
+SPAN_HEADER = "X-Blit-Span"
+HEDGE_HEADER = "X-Blit-Hedge"
+REQUEST_ID_HEADER = "X-Blit-Request"
+# Response side: the peer reports which cache tier answered, so the
+# front door's access record carries the tier outcome it cannot see.
+TIER_HEADER = "X-Blit-Tier"
+
+
+def trace_headers(ctx: Optional[Dict] = None, *, hedge: bool = False,
+                  rid: Optional[str] = None) -> Dict[str, str]:
+    """The outgoing headers for one hop: the ambient (or given) trace
+    context, the hedge tag, and the request id."""
+    if ctx is None:
+        ctx = observability.tracer().context()
+    out: Dict[str, str] = {}
+    if ctx:
+        out[TRACE_HEADER] = str(ctx.get("trace", ""))
+        out[SPAN_HEADER] = str(ctx.get("span", ""))
+    if hedge:
+        out[HEDGE_HEADER] = "1"
+    if rid:
+        out[REQUEST_ID_HEADER] = rid
+    return out
+
+
+def trace_context_from(headers: Optional[Dict]) -> Optional[Dict]:
+    """The ``{"trace", "span"}`` context a request's headers carry
+    (None when absent) — feed it to ``tracer().activate`` so peer-side
+    spans parent onto the caller's span across the process boundary."""
+    if not headers:
+        return None
+    trace = headers.get(TRACE_HEADER.lower())
+    span = headers.get(SPAN_HEADER.lower())
+    if not trace or not span:
+        return None
+    return {"trace": trace, "span": span}
 
 
 # -- wire codecs -------------------------------------------------------------
@@ -111,10 +156,13 @@ def request_from_wire(doc: Dict):
 
 
 def http_json(method: str, url: str, path: str, doc: Optional[Dict] = None,
-              timeout: float = 10.0) -> Tuple[int, Dict[str, str], object]:
+              timeout: float = 10.0,
+              headers: Optional[Dict[str, str]] = None,
+              ) -> Tuple[int, Dict[str, str], object]:
     """One JSON request to ``url`` (``http://host:port``) →
     ``(status, headers, parsed body)`` — body is the parsed JSON when
-    the response says so, else the raw text (``/metrics``).  Raises
+    the response says so, else the raw text (``/metrics``).  ``headers``
+    adds extra request headers (the trace-context hop).  Raises
     ``OSError`` on transport failure (refused/reset/timeout), which the
     front door classifies as a peer failure."""
     import http.client
@@ -125,11 +173,11 @@ def http_json(method: str, url: str, path: str, doc: Optional[Dict] = None,
                                       parts.port or 80, timeout=timeout)
     try:
         body = None
-        headers = {}
+        req_hdrs = dict(headers or {})
         if doc is not None:
             body = json.dumps(doc).encode()
-            headers["Content-Type"] = "application/json"
-        conn.request(method, path, body=body, headers=headers)
+            req_hdrs["Content-Type"] = "application/json"
+        conn.request(method, path, body=body, headers=req_hdrs)
         resp = conn.getresponse()
         payload = resp.read()
         hdrs = {k.lower(): v for k, v in resp.getheaders()}
@@ -148,11 +196,13 @@ def http_json(method: str, url: str, path: str, doc: Optional[Dict] = None,
 
 def _make_server(router: Callable, port: int, host: str = "127.0.0.1"):
     """A ThreadingHTTPServer whose GET/POST route through ``router``:
-    ``router(method, path, doc) -> (status, body, ctype, headers)`` —
-    the :func:`blit.monitor._make_http_server` shape, generalized so the
-    peer and the front door share one handler.  ``host`` defaults to
-    loopback (safe local default); a multi-host fleet binds
-    ``"0.0.0.0"`` (``blit fleet-peer --host``)."""
+    ``router(method, path, doc, headers) -> (status, body, ctype,
+    headers)`` — the :func:`blit.monitor._make_http_server` shape,
+    generalized so the peer and the front door share one handler.
+    ``headers`` is the request's header map with lower-cased keys (the
+    trace-context hop rides it).  ``host`` defaults to loopback (safe
+    local default); a multi-host fleet binds ``"0.0.0.0"``
+    (``blit fleet-peer --host``)."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     class Handler(BaseHTTPRequestHandler):
@@ -166,7 +216,9 @@ def _make_server(router: Callable, port: int, host: str = "127.0.0.1"):
                     except ValueError:
                         self.send_error(400, "unparseable JSON body")
                         return
-                status, body, ctype, extra = router(method, self.path, doc)
+                hdrs = {k.lower(): v for k, v in self.headers.items()}
+                status, body, ctype, extra = router(
+                    method, self.path, doc, hdrs)
             except Exception as e:  # noqa: BLE001 — a request must not kill
                 log.warning("http route failed", exc_info=True)
                 status, body, ctype, extra = (
@@ -199,6 +251,23 @@ def _make_server(router: Callable, port: int, host: str = "127.0.0.1"):
 def _json_resp(status: int, doc: Dict,
                headers: Optional[Dict] = None) -> Tuple:
     return status, json.dumps(doc), "application/json", headers or {}
+
+
+def snapshot_with(timeline, name: Optional[str] = None) -> Dict:
+    """This process's telemetry-snapshot wire document WITH spans — the
+    ``/snapshot`` body both the peer and the front door serve
+    (ISSUE 15 tentpole #4): the process timeline merged with the
+    serving component's (histogram exemplars ride the state), plus the
+    full span buffer, stitchable by ``blit trace-view --fleet``."""
+    from blit.observability import Timeline, telemetry_snapshot
+
+    doc = telemetry_snapshot(spans=True)
+    merged = Timeline.from_state(doc["timeline"])
+    merged.merge(timeline)
+    doc["timeline"] = merged.state()
+    if name is not None:
+        doc["name"] = name
+    return doc
 
 
 def _error_resp(e: BaseException) -> Tuple:
@@ -238,10 +307,17 @@ class PeerServer:
                  host: str = "127.0.0.1",
                  lease_dir: Optional[str] = None, proc: int = 0,
                  beat_interval_s: float = 0.5,
-                 request_timeout_s: float = 300.0):
+                 request_timeout_s: float = 300.0,
+                 config: SiteConfig = DEFAULT):
         self.service = service
         self.name = name
         self.request_timeout_s = float(request_timeout_s)
+        # Per-request access records (ISSUE 15 tentpole #2): one line
+        # per handled /product with trace id, tier outcome, queue wait
+        # and status — None (one attribute test per request) unless
+        # BLIT_REQUEST_LOG / SiteConfig.request_log_dir is set.
+        self.request_log = observability.request_log_for(
+            f"peer-{name}", config)
         # The monitor plane's surface, reused wholesale: health() folds
         # breakers/recover-hooks/SLO burn; fleet_report() renders the
         # service timeline as native-histogram Prometheus exposition.
@@ -270,72 +346,137 @@ class PeerServer:
         self._counts_lock = threading.Lock()
 
     # -- routing -----------------------------------------------------------
-    def _route(self, method: str, path: str, doc: Optional[Dict]) -> Tuple:
+    def _route(self, method: str, path: str, doc: Optional[Dict],
+               headers: Optional[Dict] = None) -> Tuple:
         if method == "GET" and path.startswith("/healthz"):
             return _json_resp(200, self.health())
         if method == "GET" and path.startswith("/metrics"):
-            from blit.observability import render_prometheus
+            from blit.observability import (
+                OPENMETRICS_CTYPE,
+                PROM_CTYPE,
+                render_prometheus,
+                wants_openmetrics,
+            )
 
-            return (200, render_prometheus(self._pub.fleet_report()),
-                    "text/plain; version=0.0.4", {})
+            om = wants_openmetrics((headers or {}).get("accept"))
+            return (200, render_prometheus(self._pub.fleet_report(),
+                                           openmetrics=om),
+                    OPENMETRICS_CTYPE if om else PROM_CTYPE, {})
         if method == "GET" and path.startswith("/stats"):
             return _json_resp(200, self.stats())
+        if method == "GET" and path.startswith("/snapshot"):
+            # The fleet trace harvest surface (ISSUE 15 tentpole #4):
+            # this process's span batch + merged timeline state (with
+            # histogram exemplars), in the telemetry-snapshot wire
+            # shape — `blit trace-view --fleet <url>` stitches these.
+            return _json_resp(200, self.snapshot())
         if method == "POST" and path.startswith("/product"):
-            return self._handle_product(doc or {})
+            return self._handle_product(doc or {}, headers or {})
         if method == "POST" and path.startswith("/warm"):
-            return self._handle_warm(doc or {})
+            return self._handle_warm(doc or {}, headers or {})
         if method == "POST" and path.startswith("/drain"):
             threading.Thread(target=self.drain, name=f"{self.name}-drain",
                              daemon=True).start()
             return _json_resp(200, {"draining": True})
         return _json_resp(404, {"error": f"no route {method} {path}"})
 
-    def _handle_product(self, doc: Dict) -> Tuple:
+    def _handle_product(self, doc: Dict, headers: Dict) -> Tuple:
         with self._counts_lock:
             self.counts["product"] += 1
+        # Reactivate the caller's trace context (ISSUE 15 tentpole #1):
+        # everything this request does on the peer — serve.reduce on the
+        # scheduler's job thread included, via the submit-time context
+        # capture — parents onto the FRONT DOOR's dispatch span, so one
+        # request is one trace across processes.
+        ctx = trace_context_from(headers)
+        hedge = headers.get(HEDGE_HEADER.lower()) == "1"
+        rid = headers.get(REQUEST_ID_HEADER.lower()) or observability.new_id()
+        tr = observability.tracer()
+        t0 = time.perf_counter()
+        status, code, ticket, nbytes = "error", 500, None, 0
+        priority = client = deadline_s = None
         try:
-            req, priority, client, deadline_s = request_from_wire(doc)
-            # The chaos schedule's injection point: kill/hang/delay THIS
-            # peer on the Nth handled request (blit chaos --fleet).
-            faults.fire("peer.request", key=str(req.raw_source))
-            timeout = (min(self.request_timeout_s, deadline_s)
-                       if deadline_s is not None else self.request_timeout_s)
-            try:
-                header, data = self.service.get(
-                    req, timeout=timeout, priority=priority, client=client,
+            with tr.activate(ctx):
+                req, priority, client, deadline_s = request_from_wire(doc)
+                # The chaos schedule's injection point: kill/hang/delay
+                # THIS peer on the Nth handled request (chaos --fleet).
+                faults.fire("peer.request", key=str(req.raw_source))
+                timeout = (min(self.request_timeout_s, deadline_s)
+                           if deadline_s is not None
+                           else self.request_timeout_s)
+                # submit + result (not service.get): the ticket carries
+                # the tier outcome and queue wait the access record —
+                # and the front door, via the tier response header —
+                # need.
+                ticket = self.service.submit(
+                    req, priority=priority, client=client,
                     deadline_s=deadline_s)
-            except TimeoutError as e:
-                if deadline_s is None:
-                    raise
-                # The reduction ran PAST the caller's deadline (the
-                # admission estimate under-predicted): that is a
-                # deadline verdict — 504, which the front door treats
-                # as breaker-NEUTRAL — not a peer failure that should
-                # trip a healthy host's breaker.
-                raise DeadlineExpired(
-                    f"deadline {deadline_s:.3f}s expired mid-compute: "
-                    f"{e}") from e
+                try:
+                    header, data = self.service.result(ticket,
+                                                       timeout=timeout)
+                except TimeoutError as e:
+                    if deadline_s is None:
+                        raise
+                    # The reduction ran PAST the caller's deadline (the
+                    # admission estimate under-predicted): that is a
+                    # deadline verdict — 504, which the front door
+                    # treats as breaker-NEUTRAL — not a peer failure
+                    # that should trip a healthy host's breaker.
+                    raise DeadlineExpired(
+                        f"deadline {deadline_s:.3f}s expired "
+                        f"mid-compute: {e}") from e
+            nbytes = data.nbytes
+            status, code = "ok", 200
+            return _json_resp(200, encode_product(header, data),
+                              {TIER_HEADER: ticket.source,
+                               REQUEST_ID_HEADER: rid})
         except BaseException as e:  # noqa: BLE001 — mapped onto the wire
-            return _error_resp(e)
-        return _json_resp(200, encode_product(header, data))
+            from blit.serve.scheduler import classify_failure
 
-    def _handle_warm(self, doc: Dict) -> Tuple:
+            resp = _error_resp(e)
+            status, _ = classify_failure(e)
+            # The record's code is WIRE truth — what this handler
+            # actually answered (matches classify_failure except the
+            # bare-TimeoutError corner, where the wire says 500).
+            code = resp[0]
+            return resp
+        finally:
+            if self.request_log is not None:
+                dt = time.perf_counter() - t0
+                self.request_log.record(
+                    rid=rid, trace=(ctx or {}).get("trace"), role="peer",
+                    peer=self.name, client=client, priority=priority,
+                    fp=(ticket.fingerprint[:16] if ticket else None),
+                    tier=(ticket.source if ticket else None),
+                    queue_wait_s=(round(ticket.queue_wait_s(), 6)
+                                  if ticket else None),
+                    deadline_s=deadline_s,
+                    deadline_left_s=(round(deadline_s - dt, 6)
+                                     if deadline_s is not None else None),
+                    hedged=(1 if hedge else None), status=status,
+                    code=code, bytes=nbytes, duration_s=round(dt, 6))
+
+    def _handle_warm(self, doc: Dict, headers: Dict) -> Tuple:
         """Cache-warm hints (ISSUE 14): submit each recipe at the
         lowest priority, fire-and-forget — a warm failure is a cold
         cache, never an error.  The peer's own cache/single-flight
-        machinery dedupes repeats."""
+        machinery dedupes repeats.  Warm reductions parent onto the
+        hinting door's trace (ISSUE 15) so replication work is
+        attributable to the request that made the entry hot."""
         accepted = rejected = 0
         from blit.serve.service import ProductRequest
 
-        for recipe in (doc.get("recipes") or []):
-            with self._counts_lock:
-                self.counts["warm"] += 1
-            try:
-                self.service.submit(ProductRequest.from_recipe(recipe),
-                                    priority=9, client="fleet-warm")
-                accepted += 1
-            except Exception:  # noqa: BLE001 — warming is best-effort
-                rejected += 1
+        tr = observability.tracer()
+        with tr.activate(trace_context_from(headers)):
+            for recipe in (doc.get("recipes") or []):
+                with self._counts_lock:
+                    self.counts["warm"] += 1
+                try:
+                    self.service.submit(ProductRequest.from_recipe(recipe),
+                                        priority=9, client="fleet-warm")
+                    accepted += 1
+                except Exception:  # noqa: BLE001 — warming is best-effort
+                    rejected += 1
         self.service.timeline.count("serve.warm", accepted)
         return _json_resp(202, {"accepted": accepted,
                                 "rejected": rejected})
@@ -359,6 +500,10 @@ class PeerServer:
         with self._counts_lock:
             s["http"] = dict(self.counts)
         return s
+
+    def snapshot(self) -> Dict:
+        """This peer's ``/snapshot`` body (:func:`snapshot_with`)."""
+        return snapshot_with(self.service.timeline, self.name)
 
     # -- lifecycle ---------------------------------------------------------
     def _beat_loop(self) -> None:
@@ -399,6 +544,8 @@ class PeerServer:
         self._server.server_close()
         self._server_thread = None
         self._pub.close()
+        if self.request_log is not None:
+            self.request_log.close()
 
     def __enter__(self):
         return self.start()
@@ -426,21 +573,38 @@ class FrontDoorServer:
         self.url = f"http://{adv}:{self.port}"
         self._server_thread: Optional[threading.Thread] = None
 
-    def _route(self, method: str, path: str, doc: Optional[Dict]) -> Tuple:
+    def _route(self, method: str, path: str, doc: Optional[Dict],
+               headers: Optional[Dict] = None) -> Tuple:
         if method == "GET" and path.startswith("/healthz"):
             return _json_resp(200, self.door.health())
         if method == "GET" and path.startswith("/metrics"):
-            return (200, self.door.metrics_prometheus(),
-                    "text/plain; version=0.0.4", {})
+            from blit.observability import (
+                OPENMETRICS_CTYPE,
+                PROM_CTYPE,
+                wants_openmetrics,
+            )
+
+            om = wants_openmetrics((headers or {}).get("accept"))
+            return (200, self.door.metrics_prometheus(openmetrics=om),
+                    OPENMETRICS_CTYPE if om else PROM_CTYPE, {})
         if method == "GET" and path.startswith("/stats"):
             return _json_resp(200, self.door.stats())
+        if method == "GET" and path.startswith("/snapshot"):
+            return _json_resp(200, snapshot_with(self.door.timeline,
+                                                 "door"))
         if method == "POST" and path.startswith("/product"):
+            # An external client's trace continues through the door
+            # (ISSUE 15): activate its context so the door's
+            # fleet.request span — and everything downstream — parents
+            # onto it.
+            tr = observability.tracer()
             try:
-                req, priority, client, deadline_s = request_from_wire(
-                    doc or {})
-                header, data = self.door.get(
-                    req, priority=priority, client=client,
-                    deadline_s=deadline_s)
+                with tr.activate(trace_context_from(headers)):
+                    req, priority, client, deadline_s = request_from_wire(
+                        doc or {})
+                    header, data = self.door.get(
+                        req, priority=priority, client=client,
+                        deadline_s=deadline_s)
             except BaseException as e:  # noqa: BLE001 — mapped
                 return _error_resp(e)
             return _json_resp(200, encode_product(header, data))
@@ -555,13 +719,20 @@ def retry_after_from(headers: Dict[str, str], body: object) -> float:
 
 __all__ = [
     "FrontDoorServer",
+    "HEDGE_HEADER",
     "PeerServer",
+    "REQUEST_ID_HEADER",
+    "SPAN_HEADER",
+    "TIER_HEADER",
+    "TRACE_HEADER",
     "decode_product",
     "encode_product",
     "http_json",
     "install_drain_handler",
     "request_from_wire",
     "retry_after_from",
+    "trace_context_from",
+    "trace_headers",
     "wait_http_ready",
     "wire_request",
 ]

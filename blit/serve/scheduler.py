@@ -77,6 +77,24 @@ class Cancelled(RuntimeError):
     """The job was cancelled while still queued."""
 
 
+def classify_failure(e: BaseException):
+    """The access-record ``(status, code)`` of a serve-path failure —
+    ONE mapping shared by the fleet door, the peer HTTP handler and
+    ``ProductService.get`` (ISSUE 15), so one failure shape never
+    yields three different record shapes in one spool.  Success is the
+    caller's ``("ok", 200)``; order matters (DeadlineExpired ⊂
+    Overloaded).  A bare ``TimeoutError`` (the caller's wait budget
+    burned with no declared deadline) records as ``timeout``/504 — a
+    deadline-class outcome for the requester."""
+    if isinstance(e, DeadlineExpired):
+        return "deadline", 504
+    if isinstance(e, Overloaded):
+        return "overloaded", 503
+    if isinstance(e, TimeoutError):
+        return "timeout", 504
+    return "error", 500
+
+
 class Job:
     """One scheduled unit of work.  ``wait()``/``result()`` block on
     completion; queue/run timings hang off the instance for reporting."""

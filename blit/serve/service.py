@@ -252,6 +252,15 @@ class Ticket:
                 or self._flight is None
                 or self._flight.done.is_set())
 
+    def queue_wait_s(self) -> float:
+        """Seconds this ticket's reduction sat queued (0.0 for cache
+        hits and not-yet-dispatched flights) — the access record's
+        queue-wait field (ISSUE 15)."""
+        f = self._flight
+        if f is None or f.job is None:
+            return 0.0
+        return f.job.wait_s or 0.0
+
 
 class ProductService:
     """The serving front door (module docstring).  One instance per
@@ -296,6 +305,13 @@ class ProductService:
             "requests": 0, "coalesced": 0, "cache_hits": 0,
             "scheduled": 0, "rejected": 0,
         }
+        # Per-request access records (ISSUE 15): library/bench callers
+        # going through get() write one bounded JSON line per request —
+        # None (one attribute test per request) unless BLIT_REQUEST_LOG
+        # / SiteConfig.request_log_dir enables it.  The fleet peer's
+        # HTTP handler keeps its OWN log (it submits tickets directly),
+        # so one request never double-records.
+        self.request_log = observability.request_log_for("serve", config)
         # Live monitoring (ISSUE 11): when the process-wide publisher is
         # enabled (BLIT_MONITOR_* / SiteConfig monitor_* knobs), this
         # service's timeline joins its watch set — queue depth, wait
@@ -568,12 +584,45 @@ class ProductService:
         client: str = "anon",
         deadline_s: Optional[float] = None,
     ) -> Tuple[Dict, np.ndarray]:
-        """Synchronous convenience: ``submit`` + ``result``."""
-        return self.result(
-            self.submit(request, priority=priority, client=client,
-                        deadline_s=deadline_s),
-            timeout=timeout,
-        )
+        """Synchronous convenience: ``submit`` + ``result``.  When
+        request logging is enabled (ISSUE 15), every call — served,
+        refused or failed — appends exactly one access record."""
+        if self.request_log is None:
+            return self.result(
+                self.submit(request, priority=priority, client=client,
+                            deadline_s=deadline_s),
+                timeout=timeout,
+            )
+        t0 = time.perf_counter()
+        ctx = observability.tracer().context()
+        status, code, ticket, nbytes = "error", 500, None, 0
+        try:
+            ticket = self.submit(request, priority=priority,
+                                 client=client, deadline_s=deadline_s)
+            header, data = self.result(ticket, timeout=timeout)
+            nbytes = data.nbytes
+            status, code = "ok", 200
+            return header, data
+        except BaseException as e:
+            from blit.serve.scheduler import classify_failure
+
+            status, code = classify_failure(e)
+            raise
+        finally:
+            dt = time.perf_counter() - t0
+            self.request_log.record(
+                rid=observability.new_id(),
+                trace=(ctx or {}).get("trace"), role="serve",
+                client=client, priority=priority,
+                fp=(ticket.fingerprint[:16] if ticket else None),
+                tier=(ticket.source if ticket else None),
+                queue_wait_s=(round(ticket.queue_wait_s(), 6)
+                              if ticket else None),
+                deadline_s=deadline_s,
+                deadline_left_s=(round(deadline_s - dt, 6)
+                                 if deadline_s is not None else None),
+                status=status, code=code, bytes=nbytes,
+                duration_s=round(dt, 6))
 
     def cancel(self, ticket: Ticket) -> bool:
         """Withdraw a ticket.  The LAST ticket of a still-queued flight
@@ -678,6 +727,8 @@ class ProductService:
         return self._draining
 
     def close(self, timeout: Optional[float] = 30.0) -> None:
+        if self.request_log is not None:
+            self.request_log.close()
         if self._scrubber is not None:
             self._scrubber.close()
             self._scrubber = None

@@ -176,7 +176,9 @@ class TestFailover:
             def refuse(*a, **kw):
                 raise Overloaded("full", retry_after_s=0.2)
 
-            monkeypatch.setattr(s.service, "get", refuse)
+            # submit is the peer handler's seam (ISSUE 15: it needs
+            # the ticket) — and where admission refuses.
+            monkeypatch.setattr(s.service, "submit", refuse)
         with pytest.raises(Overloaded):
             fleet.door.get(req)
 
@@ -280,13 +282,13 @@ class TestDeadlinePropagation:
         req = make_req(tmp_path, 7)
         seen = {}
         for s in fleet.servers:
-            real = s.service.get
+            real = s.service.submit
 
             def spy(r, _real=real, **kw):
                 seen.setdefault("deadline_s", kw.get("deadline_s"))
                 return _real(r, **kw)
 
-            monkeypatch.setattr(s.service, "get", spy)
+            monkeypatch.setattr(s.service, "submit", spy)
         fleet.door.get(req, deadline_s=30.0)
         # The peer saw the REMAINING budget, not the original.
         assert seen["deadline_s"] is not None

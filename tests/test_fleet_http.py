@@ -102,7 +102,9 @@ class TestPeerServer:
         def refuse(*a, **kw):
             raise Overloaded("queue full", retry_after_s=0.321)
 
-        monkeypatch.setattr(peer.service, "get", refuse)
+        # submit is the peer handler's seam (it needs the ticket for
+        # the ISSUE 15 access record) — and where admission refuses.
+        monkeypatch.setattr(peer.service, "submit", refuse)
         status, headers, body = http_json(
             "POST", peer.url, "/product",
             wire_request(ProductRequest(raw=raw, nfft=NFFT)), timeout=30)
@@ -117,7 +119,7 @@ class TestPeerServer:
         def expire(*a, **kw):
             raise DeadlineExpired("dead on arrival")
 
-        monkeypatch.setattr(peer.service, "get", expire)
+        monkeypatch.setattr(peer.service, "submit", expire)
         status, _, body = http_json(
             "POST", peer.url, "/product",
             wire_request(ProductRequest(raw=raw, nfft=NFFT)), timeout=30)
@@ -127,13 +129,13 @@ class TestPeerServer:
     def test_deadline_rides_the_wire_into_the_scheduler(
             self, peer, raw, monkeypatch):
         seen = {}
-        real = peer.service.get
+        real = peer.service.submit
 
         def spy(req, **kw):
             seen.update(kw)
             return real(req, **kw)
 
-        monkeypatch.setattr(peer.service, "get", spy)
+        monkeypatch.setattr(peer.service, "submit", spy)
         http_json("POST", peer.url, "/product",
                   wire_request(ProductRequest(raw=raw, nfft=NFFT),
                                deadline_s=7.5), timeout=120)

@@ -537,11 +537,14 @@ class TestFlightRecorder:
         assert any("died" in r or "breaker" in r for r in reasons)
 
     def test_dump_rate_limited_and_forceable(self, tmp_path, monkeypatch):
+        # Rate limiting is per REASON class (ISSUE 15 satellite): a
+        # repeat of one reason is suppressed, a different reason is
+        # not, and force always overrides.
         monkeypatch.setenv("BLIT_FLIGHT_DIR", str(tmp_path))
         rec = observability.FlightRecorder(min_interval_s=60.0)
-        assert rec.dump("first") is not None
-        assert rec.dump("suppressed") is None
-        assert rec.dump("forced", force=True) is not None
+        assert rec.dump("first: a") is not None
+        assert rec.dump("first: b — suppressed repeat") is None
+        assert rec.dump("first: c", force=True) is not None
 
     def test_ring_is_bounded(self):
         rec = observability.FlightRecorder(capacity=16)
