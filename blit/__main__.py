@@ -658,6 +658,8 @@ def _cmd_serve_bench(args: argparse.Namespace) -> int:
     from blit.serve.http import install_drain_handler
     from blit.testing import synth_raw
 
+    if args.archive_day:
+        return _serve_bench_archive_day(args)
     if args.fleet:
         return _serve_bench_fleet(args)
     from blit.config import DEFAULT
@@ -831,7 +833,16 @@ def _serve_bench_fleet(args: argparse.Namespace) -> int:
     from blit.observability import HistogramStats, Timeline
     from blit.serve import Overloaded, ProductRequest
     from blit.serve.fleet import FleetFrontDoor
-    from blit.serve.http import http_json, install_drain_handler
+    from blit.serve.http import (
+        WIRE_CTYPE,
+        WIRE_HEADER,
+        decode_product,
+        decode_product_wire,
+        http_json,
+        http_request,
+        install_drain_handler,
+        wire_request,
+    )
     from blit.serve.scheduler import DeadlineExpired
     from blit.testing import synth_raw
 
@@ -934,6 +945,39 @@ def _serve_bench_fleet(args: argparse.Namespace) -> int:
                 }
             served_tier = tiers["hit.ram"] + tiers["hit.disk"]
             total_tier = served_tier + tiers["miss"]
+            # Wire back-compat probe (ISSUE 16): ONE explicit
+            # legacy-JSON request and ONE binary request against the
+            # same peer for the same product — the CI smoke pins that
+            # the binary frame was actually negotiated somewhere AND
+            # that a client which never sends the binary Accept still
+            # gets the identical bytes.
+            try:
+                probe_doc = json.dumps(wire_request(
+                    reqs[0], client="compat")).encode()
+                purl = sorted(peers.values())[0]
+                st_j, hdr_j, pay_j = http_request(
+                    "POST", purl, "/product", body=probe_doc,
+                    headers={"Content-Type": "application/json"},
+                    timeout=60.0)
+                st_b, hdr_b, pay_b = http_request(
+                    "POST", purl, "/product", body=probe_doc,
+                    headers={"Content-Type": "application/json",
+                             "Accept": f"{WIRE_CTYPE}, application/json"},
+                    timeout=60.0)
+                _, dj = decode_product(json.loads(pay_j))
+                _, db = decode_product_wire(
+                    pay_b, encoding=hdr_b.get("content-encoding"))
+                compat = {
+                    "legacy_wire": hdr_j.get(WIRE_HEADER.lower()),
+                    "binary_wire": hdr_b.get(WIRE_HEADER.lower()),
+                    "byte_identical": bool(
+                        st_j == 200 and st_b == 200
+                        and dj.dtype == db.dtype
+                        and dj.shape == db.shape
+                        and dj.tobytes() == db.tobytes()),
+                }
+            except Exception as e:  # noqa: BLE001 — probe is advisory
+                compat = {"error": repr(e)}
             # Fleet trace harvest (ISSUE 15 tentpole #4): stitch the
             # peers' span batches (their live /snapshot endpoints, with
             # histogram exemplars) and the door's own spans/hists into
@@ -1010,6 +1054,24 @@ def _serve_bench_fleet(args: argparse.Namespace) -> int:
                         if hedges else 0.0),
                 },
                 "failovers": c.get("fleet.failover", 0),
+                # The hot-path data plane (ISSUE 16): which wire each
+                # peer answer rode, the keep-alive pool's reuse ratio,
+                # and the negotiation/back-compat probe CI asserts on.
+                "wire": {
+                    "mode": fstats.get("wire"),
+                    "binary_responses": c.get("fleet.wire.binary", 0),
+                    "json_responses": c.get("fleet.wire.json", 0),
+                    "wire_gb": round(
+                        tl.hists["fleet.wire_bytes"].total / 1e9, 6)
+                    if "fleet.wire_bytes" in tl.hists else 0.0,
+                    "pool": {
+                        "open": c.get("fleet.pool.open", 0),
+                        "reuse": c.get("fleet.pool.reuse", 0),
+                        "evict": c.get("fleet.pool.evict", 0),
+                        "idle": fstats.get("pool"),
+                    },
+                    "compat": compat,
+                },
                 "rejected_overloaded": rejected[0],
                 "deadline_expired": expired[0],
                 "per_peer": per_peer,
@@ -1032,6 +1094,267 @@ def _serve_bench_fleet(args: argparse.Namespace) -> int:
             door.close()
             _reap_fleet_peers(procs)
     return 1 if errors else 0
+
+
+def _serve_bench_archive_day(args: argparse.Namespace) -> int:
+    """``serve-bench --archive-day`` (ISSUE 16 tentpole #4): replay a
+    zipfian MULTI-SESSION observing day over REAL ``fleet-peer``
+    subprocesses, once per wire — binary then legacy JSON, identical
+    seeds, fresh peer caches each pass — and report what the hot-path
+    data plane is worth: per-tier hit rate (RAM / disk / encoded-wire),
+    wire GB/s off the door's byte histogram, serialize / deserialize
+    p50/p99, and the binary-vs-JSON A/B with a byte-identity pin.  The
+    record carries ``config.backend`` (the rig) and a flat ``metrics``
+    dict so ``blit bench-diff`` extracts and gates it exactly like the
+    ingest records."""
+    import math
+    import os
+    import random
+    import tempfile
+    import threading
+    import time as _time
+
+    from blit import monitor
+    from blit.config import DEFAULT
+    from blit.observability import HistogramStats, Timeline
+    from blit.serve import Overloaded, ProductRequest
+    from blit.serve.fleet import FleetFrontDoor
+    from blit.serve.http import http_json, install_drain_handler
+    from blit.serve.scheduler import DeadlineExpired
+    from blit.testing import synth_raw
+
+    try:
+        import jax
+
+        backend = jax.default_backend()
+    except Exception:  # noqa: BLE001 — rig label only
+        backend = (os.environ.get("JAX_PLATFORMS") or "cpu").split(
+            ",")[0] or "cpu"
+
+    def q(h, p: float) -> float:
+        return round(h.percentile(p), 6) if h is not None and h.n else 0.0
+
+    with tempfile.TemporaryDirectory(prefix="blit-archive-day-") as td:
+        # The day's archive: --sessions observing sessions, each with
+        # --distinct products over its own recordings.  Popularity is
+        # zipfian along BOTH axes — a few hot sessions dominate the day
+        # and within a session a few hot products dominate — which is
+        # what makes the encoded-wire cache tier earn its bytes.
+        ntime = (8 + 3) * args.nfft  # 8 PFB frames at ntap=4
+        reqs, weights = [], []
+        for s in range(args.sessions):
+            for i in range(args.distinct):
+                path = os.path.join(td, f"day-s{s:02d}p{i:03d}.raw")
+                synth_raw(path, nblocks=1, obsnchan=2,
+                          ntime_per_block=ntime,
+                          seed=s * args.distinct + i)
+                reqs.append(ProductRequest(raw=path, nfft=args.nfft,
+                                           nint=1))
+                weights.append(1.0 / (math.pow(s + 1, args.zipf_s)
+                                      * math.pow(i + 1, args.zipf_s)))
+        picks = random.Random(args.seed).choices(
+            range(len(reqs)), weights=weights, k=args.requests)
+
+        def one_pass(wire_mode: str, tag: str):
+            """One full day replay on a fresh fleet speaking
+            ``wire_mode``; returns ``(pass_report, probe)`` where
+            ``probe`` is the decoded hottest product for the cross-wire
+            byte-identity pin."""
+            pd = os.path.join(td, tag)
+            os.makedirs(pd, exist_ok=True)
+            tl = Timeline()
+            # Pin the pass's wire on the environment: fleet_defaults
+            # lets ambient BLIT_FLEET_WIRE* override the config, which
+            # would silently turn the A/B into two identical passes.
+            pinned = {"BLIT_FLEET_WIRE": wire_mode,
+                      "BLIT_FLEET_WIRE_DEFLATE": "1" if args.deflate
+                      else "0",
+                      "BLIT_REQUEST_LOG": ""}
+            prev = {k: os.environ.get(k) for k in pinned}
+            os.environ.update(pinned)
+            procs, peers, lease_dir = _spawn_fleet_peers(
+                pd, args.peers, concurrency=args.concurrency,
+                queue_depth=args.queue_depth, ram_bytes=args.ram_bytes,
+                extra_env=pinned)
+            try:
+                door = FleetFrontDoor(
+                    peers, lease_dir=lease_dir, timeline=tl,
+                    replicas=args.replicas, peer_ttl_s=args.peer_ttl,
+                    poll_s=min(0.1, args.peer_ttl / 4),
+                    hedge_floor_s=args.hedge_floor_ms / 1e3,
+                    request_timeout_s=60.0,
+                    config=DEFAULT.with_(fleet_wire=wire_mode)).start()
+            finally:
+                for k, v in prev.items():
+                    if v is None:
+                        os.environ.pop(k, None)
+                    else:
+                        os.environ[k] = v
+            uninstall = install_drain_handler(lambda: door.drain())
+            lat = HistogramStats()
+            lock = threading.Lock()
+            rejected = [0]
+            delivered = [0]  # decoded product bytes handed to clients
+            errors: list = []
+            it = iter(picks)
+
+            def client_loop(cid: int) -> None:
+                while True:
+                    with lock:
+                        k = next(it, None)
+                    if k is None:
+                        return
+                    t = _time.perf_counter()
+                    try:
+                        _, d = door.get(reqs[k], client=f"client{cid}")
+                        with lock:
+                            delivered[0] += d.nbytes
+                    except (Overloaded, DeadlineExpired) as e:
+                        with lock:
+                            rejected[0] += 1
+                        if isinstance(e, Overloaded):
+                            _time.sleep(min(0.25, e.retry_after_s))
+                    except Exception as e:  # noqa: BLE001 — reported
+                        with lock:
+                            errors.append(repr(e))
+                    lat.observe(_time.perf_counter() - t)
+
+            try:
+                t0 = _time.perf_counter()
+                threads = [threading.Thread(target=client_loop,
+                                            args=(c,))
+                           for c in range(args.clients)]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                wall = _time.perf_counter() - t0
+                # The byte-identity probe: the day's hottest product,
+                # decoded through THIS pass's wire.
+                probe = None
+                try:
+                    ph, pdata = door.get(reqs[0], client="probe")
+                    probe = (dict(ph), pdata.dtype.str,
+                             tuple(pdata.shape), pdata.tobytes())
+                except Exception as e:  # noqa: BLE001 — reported
+                    errors.append(f"probe: {e!r}")
+                tiers = {"hit.ram": 0, "hit.disk": 0, "hit.wire": 0,
+                         "miss": 0}
+                for _name, url in sorted(peers.items()):
+                    try:
+                        _, _, s = http_json("GET", url, "/stats",
+                                            timeout=5.0)
+                    except OSError:
+                        continue
+                    cst = (s.get("cache") or {})
+                    for k in tiers:
+                        tiers[k] += int(cst.get(k, 0))
+                # The peers' serialize histogram rides their /snapshot
+                # endpoints (merged across the fleet); deserialize and
+                # wire bytes live on the door's own timeline.
+                _, peer_hists = monitor.gather_trace_sources(
+                    list(peers.values()))
+                ser = peer_hists.get("fleet.serialize_s")
+                de = tl.hists.get("fleet.deserialize_s")
+                wire_h = tl.hists.get("fleet.wire_bytes")
+                wire_bytes = float(wire_h.total) if wire_h else 0.0
+                served = tiers["hit.ram"] + tiers["hit.disk"]
+                total = served + tiers["miss"]
+                c = door.stats()["counters"]
+                rep = {
+                    "wire": wire_mode,
+                    "wall_s": round(wall, 3),
+                    "rps": (round(args.requests / wall, 1)
+                            if wall else None),
+                    "tiers": tiers,
+                    "hit_rate": (round(served / total, 4)
+                                 if total else 0.0),
+                    # wire_bytes is what moved on the socket (base64
+                    # inflates the JSON pass ~4/3); wire_gbps is the
+                    # USEFUL throughput — decoded product bytes
+                    # delivered to clients per wall second, the number
+                    # the two wires compete on.
+                    "wire_bytes": int(wire_bytes),
+                    "delivered_bytes": delivered[0],
+                    "wire_gbps": (round(delivered[0] / wall / 1e9, 6)
+                                  if wall else 0.0),
+                    "request_p50_s": q(lat, 0.50),
+                    "request_p99_s": q(lat, 0.99),
+                    "serialize_p50_s": q(ser, 0.50),
+                    "serialize_p99_s": q(ser, 0.99),
+                    "deserialize_p50_s": q(de, 0.50),
+                    "deserialize_p99_s": q(de, 0.99),
+                    "door": {
+                        "binary_responses": c.get("fleet.wire.binary",
+                                                  0),
+                        "json_responses": c.get("fleet.wire.json", 0),
+                        "pool_open": c.get("fleet.pool.open", 0),
+                        "pool_reuse": c.get("fleet.pool.reuse", 0),
+                        "pool_evict": c.get("fleet.pool.evict", 0),
+                    },
+                    "rejected": rejected[0],
+                    "errors": errors[:5],
+                }
+                return rep, probe
+            finally:
+                uninstall()
+                door.close()
+                _reap_fleet_peers(procs)
+
+        bin_rep, bin_probe = one_pass("binary", "binary")
+        json_rep, json_probe = one_pass("json", "legacy")
+        byte_identical = (bin_probe is not None
+                          and bin_probe == json_probe)
+        speedup = (json_rep["wall_s"] / bin_rep["wall_s"]
+                   if bin_rep["wall_s"] else 0.0)
+        report = {
+            "serve_bench": "archive-day",
+            "requests": args.requests,
+            "sessions": args.sessions,
+            "distinct": args.sessions * args.distinct,
+            "clients": args.clients,
+            "peers": args.peers,
+            "replicas": args.replicas,
+            "zipf_s": args.zipf_s,
+            "seed": args.seed,
+            "config": {"backend": backend, "nfft": args.nfft,
+                       "peers": args.peers,
+                       "deflate": bool(args.deflate)},
+            "binary": bin_rep,
+            "legacy_json": json_rep,
+            "ab": {
+                "byte_identical": byte_identical,
+                "wire_speedup": round(speedup, 4),
+                "binary_wall_s": bin_rep["wall_s"],
+                "json_wall_s": json_rep["wall_s"],
+                "binary_wire_gbps": bin_rep["wire_gbps"],
+                "json_wire_gbps": json_rep["wire_gbps"],
+            },
+            # The flat gate surface: bench-diff reads exactly these
+            # (throughput/hit-rate band up, latency-quantile band
+            # inverted).
+            "metrics": {
+                "fleet_hit_rate": bin_rep["hit_rate"],
+                "fleet_wire_gbps": bin_rep["wire_gbps"],
+                "wire_speedup": round(speedup, 4),
+                "fleet_request_p50_s": bin_rep["request_p50_s"],
+                "fleet_request_p99_s": bin_rep["request_p99_s"],
+                "fleet_serialize_p99_s": bin_rep["serialize_p99_s"],
+                "fleet_deserialize_p99_s":
+                    bin_rep["deserialize_p99_s"],
+            },
+            "errors": (bin_rep["errors"] + json_rep["errors"])[:5],
+        }
+        out = json.dumps(report)
+        print(out)
+        if args.out:
+            tmp = args.out + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(out + "\n")
+            os.replace(tmp, args.out)
+    if report["errors"]:
+        return 1
+    return 0 if byte_identical else 1
 
 
 def _monitor_from_flags(args: argparse.Namespace):
@@ -2602,6 +2925,21 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "+ the door's into one Perfetto trace at PATH "
                          "(plus PATH.snapshot.json for trace-view "
                          "--fleet)")
+    pb.add_argument("--archive-day", action="store_true",
+                    help="replay a zipfian multi-session observing day "
+                         "over REAL fleet-peer subprocesses, binary "
+                         "wire vs legacy JSON A/B with a byte-identity "
+                         "pin (ISSUE 16); emits a bench-diff-gateable "
+                         "record")
+    pb.add_argument("--sessions", type=int, default=4,
+                    help="observing sessions in the day, each with "
+                         "--distinct products (--archive-day)")
+    pb.add_argument("--deflate", action="store_true",
+                    help="advertise Accept-Encoding: deflate on the "
+                         "binary pass (--archive-day)")
+    pb.add_argument("--out", default=None, metavar="PATH",
+                    help="also write the report JSON here "
+                         "(--archive-day; the CI artifact)")
     pb.set_defaults(fn=_cmd_serve_bench)
 
     pfp = sub.add_parser(

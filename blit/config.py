@@ -245,6 +245,17 @@ class SiteConfig:
     fleet_hedge_floor_s: float = 0.05
     fleet_hedge_min_n: int = 16
     fleet_hot_hits: int = 3
+    # Hot-path data plane (blit/serve/http.py; ISSUE 16).  fleet_wire
+    # selects the door→peer product encoding: "binary" is the
+    # application/x-blit-product frame (no base64 tax, zero-copy
+    # decode), "json" the legacy base64 wire — products are
+    # bit-identical either way.  fleet_pool_conns bounds the per-peer
+    # keep-alive connection pool; fleet_wire_deflate adds whole-frame
+    # deflate when the client advertises it (off by default: float
+    # spectra compress poorly and the CPU lands on the hot path).
+    fleet_wire: str = "binary"
+    fleet_pool_conns: int = 4
+    fleet_wire_deflate: bool = False
     # Fleet request observability (blit/observability.py RequestLog +
     # histogram exemplars; ISSUE 15).  request_log_dir, when set, makes
     # every serving component (ProductService, fleet front door, peer
@@ -522,6 +533,14 @@ def fleet_defaults(config: SiteConfig = DEFAULT) -> Dict:
             "BLIT_FLEET_HEDGE_MIN_N", config.fleet_hedge_min_n)),
         "hot_hits": int(os.environ.get(
             "BLIT_FLEET_HOT_HITS", config.fleet_hot_hits)),
+        "wire": str(os.environ.get(
+            "BLIT_FLEET_WIRE", config.fleet_wire)).strip().lower(),
+        "pool_conns": int(os.environ.get(
+            "BLIT_FLEET_POOL_CONNS", config.fleet_pool_conns)),
+        "wire_deflate": str(os.environ.get(
+            "BLIT_FLEET_WIRE_DEFLATE",
+            config.fleet_wire_deflate)) not in (
+                "0", "false", "False"),
     }
 
 

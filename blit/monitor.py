@@ -1344,8 +1344,18 @@ def gather_trace_sources(sources: Iterable[str], *,
 
 # Higher-is-better scalar metrics worth tracking across BENCH rounds.
 _METRIC_KEY_RE = re.compile(
-    r"(_gbps|_per_s|_speedup|^async_speedup$|_efficiency|^hit_rate$)",
+    r"(_gbps|_per_s|_speedup|^async_speedup$|_efficiency|^hit_rate$"
+    r"|_hit_rate$)",
 )
+# Lower-is-better scalars (ISSUE 16: the serve plane gates on request
+# latency quantiles) — the noise band inverts for these.
+_LOWER_METRIC_KEY_RE = re.compile(r"_p\d+_s$")
+
+
+def metric_lower_is_better(key: str) -> bool:
+    """Is ``key`` a lower-is-better metric (a latency quantile)?  Such
+    metrics regress when the fresh value rises ABOVE the noise band."""
+    return _LOWER_METRIC_KEY_RE.search(key) is not None
 
 
 def load_bench_json(path: str) -> Dict:
@@ -1379,13 +1389,25 @@ def bench_metrics(doc: Dict) -> Dict[str, float]:
     record: for ``ingest-bench`` documents the per-leg ingest rate /
     overlap efficiency and the async speedup; for ``bench.py`` records
     the headline ``value`` (keyed by its ``metric`` name) plus every
-    top-level ``*_gbps`` / ``*_per_s`` / speedup / efficiency scalar."""
+    top-level ``*_gbps`` / ``*_per_s`` / speedup / efficiency scalar;
+    for serve-bench records (``serve-bench --archive-day``, ISSUE 16)
+    the flat ``metrics`` dict — fleet hit rate, wire GB/s, and the
+    request/serialize latency quantiles (``*_pNN_s``, which compare
+    lower-is-better)."""
     out: Dict[str, float] = {}
 
     def num(v) -> Optional[float]:
         return (float(v) if isinstance(v, (int, float))
                 and not isinstance(v, bool) else None)
 
+    if isinstance(doc.get("metrics"), dict):
+        for k, v in doc["metrics"].items():
+            f = num(v)
+            if f is None:
+                continue
+            if _METRIC_KEY_RE.search(k) or metric_lower_is_better(k):
+                out[k] = f
+        return out
     if "legs" in doc:
         for leg in doc.get("legs") or []:
             name = "async" if leg.get("async_output") else "sync"
@@ -1425,10 +1447,12 @@ def bench_diff(fresh: Dict, baselines: List[Dict], *,
     """Compare a fresh bench record against a baseline trajectory with
     noise bands: per metric, the band is ``[min·(1-rel_tol),
     max·(1+rel_tol)]`` over the trajectory — a fresh value below the
-    band REGRESSES (these are all higher-is-better scalars), above it
-    IMPROVES, inside it is ok.  The verdict is ``"regress"`` iff any
-    tracked metric regressed.  Metrics with no baseline datapoint are
-    reported as ``"new"`` and never gate.
+    band REGRESSES (throughput-style scalars are higher-is-better),
+    above it IMPROVES, inside it is ok.  Latency quantiles
+    (:func:`metric_lower_is_better`) invert: rising ABOVE the band
+    regresses, dropping below it improves.  The verdict is
+    ``"regress"`` iff any tracked metric regressed.  Metrics with no
+    baseline datapoint are reported as ``"new"`` and never gate.
 
     Baselines recorded on a DIFFERENT rig than the fresh record
     (``config.backend`` — the checked-in trajectory mixes TPU and CPU
@@ -1464,8 +1488,12 @@ def bench_diff(fresh: Dict, baselines: List[Dict], *,
         lo, hi = min(hist), max(hist)
         band_lo = lo * (1.0 - rel_tol)
         band_hi = hi * (1.0 + rel_tol)
-        status = ("regress" if v < band_lo
-                  else "improved" if v > band_hi else "ok")
+        if metric_lower_is_better(k):
+            status = ("regress" if v > band_hi
+                      else "improved" if v < band_lo else "ok")
+        else:
+            status = ("regress" if v < band_lo
+                      else "improved" if v > band_hi else "ok")
         if status == "regress":
             regressed.append(k)
         rows[k] = {"fresh": v, "lo": lo, "hi": hi,

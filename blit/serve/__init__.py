@@ -23,6 +23,13 @@ The FLEET plane (ISSUE 14) scales the same stack across hosts:
   lease-driven peer ejection/rejoin, per-peer breakers, hedged reads
   off the live p99, deadline propagation, cache-warm replication and
   graceful drain.
+
+The HOT-PATH data plane (ISSUE 16) makes the fleet wire fast:
+:class:`ConnectionPool` keep-alive sockets on every hop, the
+``application/x-blit-product`` binary frame
+(:class:`~blit.serve.http.WireError` guards decode) negotiated by
+``Accept``, and the cache's encoded-wire-body tier so a hot hit never
+re-encodes.
 """
 
 from blit.serve.cache import (
@@ -31,7 +38,12 @@ from blit.serve.cache import (
     reduction_fingerprint,
 )
 from blit.serve.fleet import FleetError, FleetFrontDoor
-from blit.serve.http import FrontDoorServer, PeerServer
+from blit.serve.http import (
+    ConnectionPool,
+    FrontDoorServer,
+    PeerServer,
+    WireError,
+)
 from blit.serve.ring import HashRing
 from blit.serve.scheduler import (
     Cancelled,
@@ -44,6 +56,7 @@ from blit.serve.service import ProductRequest, ProductService, Ticket
 
 __all__ = [
     "Cancelled",
+    "ConnectionPool",
     "DeadlineExpired",
     "FleetError",
     "FleetFrontDoor",
@@ -57,6 +70,7 @@ __all__ = [
     "ProductService",
     "Scheduler",
     "Ticket",
+    "WireError",
     "fingerprint_for",
     "reduction_fingerprint",
 ]

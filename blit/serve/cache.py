@@ -26,10 +26,21 @@ Tiers:
   by a crash mid-publish on a non-atomic filesystem, bit rot) is EVICTED
   and reported as a miss instead of raising.
 
+A third, derived tier rides both (ISSUE 16 tentpole #3): the
+**encoded wire body** — the already-framed ``application/x-blit-product``
+bytes of an entry (:func:`blit.serve.http.encode_product_wire`).  A hot
+binary-wire hit is then one memoryview write: no re-encode, no ndarray
+copy, and a disk-tier wire hit streams file bytes without materializing
+the array at all.  Wire bodies share the RAM byte budget but are always
+evicted FIRST (they are re-derivable from their product), and the disk
+form (``<fp>.wire`` = frame + CRC32 footer) is verified on load exactly
+like the product files (PR 12).
+
 Hit/miss/evict counters land on the :class:`~blit.observability.Timeline`
-(``cache.hit.ram`` / ``cache.hit.disk`` / ``cache.miss`` /
-``cache.evict.*``) and the ``cache.publish`` fault-injection point covers
-the disk publish path for drills (blit/faults.py).
+(``cache.hit.ram`` / ``cache.hit.disk`` / ``cache.hit.wire`` /
+``cache.miss`` / ``cache.evict.*``) and the ``cache.publish``
+fault-injection point covers the disk publish path for drills
+(blit/faults.py).
 """
 
 from __future__ import annotations
@@ -40,6 +51,7 @@ import logging
 import os
 import threading
 import time
+import zlib
 from collections import OrderedDict
 from typing import Dict, Optional, Sequence, Tuple, Union
 
@@ -156,15 +168,20 @@ class ProductCache:
             OrderedDict()
         )
         self._ram_used = 0
+        # Encoded-wire-body tier (ISSUE 16): fp -> (frame bytes,
+        # nbytes), LRU, sharing ram_bytes with the product entries but
+        # evicted first — a wire body is re-derivable from its product.
+        self._wire: "OrderedDict[str, Tuple[bytes, int]]" = OrderedDict()
+        self._wire_used = 0
         # Per-fingerprint hit totals (bounded: RAM/disk hits only, LRU
         # pruned alongside the RAM tier) — the fleet plane's hotness
         # signal (ISSUE 14): `hot()` feeds cache-warm replication and
         # the drain-time hot-entry hints.
         self._hits_by_fp: "OrderedDict[str, int]" = OrderedDict()
         self.counts: Dict[str, int] = {
-            "hit.ram": 0, "hit.disk": 0, "miss": 0,
+            "hit.ram": 0, "hit.disk": 0, "hit.wire": 0, "miss": 0,
             "evict.ram": 0, "evict.disk": 0, "evict.corrupt": 0,
-            "publish": 0, "publish.error": 0,
+            "evict.wire": 0, "publish": 0, "publish.error": 0,
         }
         if root is not None:
             os.makedirs(root, exist_ok=True)
@@ -183,6 +200,9 @@ class ProductCache:
     def meta_path(self, fp: str) -> str:
         return os.path.join(self.root, f"{fp}.json")
 
+    def wire_path(self, fp: str) -> str:
+        return os.path.join(self.root, f"{fp}.wire")
+
     # -- counters ----------------------------------------------------------
     def _count(self, name: str, n: int = 1) -> None:
         with self._lock:
@@ -194,6 +214,8 @@ class ProductCache:
             out = dict(self.counts)
             out["ram_entries"] = len(self._ram)
             out["ram_bytes_used"] = self._ram_used
+            out["wire_entries"] = len(self._wire)
+            out["wire_bytes_used"] = self._wire_used
         return out
 
     @property
@@ -204,6 +226,16 @@ class ProductCache:
         return served / total if total else 0.0
 
     # -- RAM tier ----------------------------------------------------------
+    def _evict_wire_locked(self, need: int) -> None:
+        """Drop LRU wire bodies until ``need`` more bytes fit the
+        shared budget (wire bodies go first: re-derivable)."""
+        while (self._ram_used + self._wire_used + need > self.ram_bytes
+               and self._wire):
+            _, (_, b) = self._wire.popitem(last=False)
+            self._wire_used -= b
+            self.counts["evict.wire"] += 1
+            self.timeline.count("cache.evict.wire")
+
     def _ram_put_locked(self, fp: str, header: Dict,
                         data: np.ndarray) -> None:
         nbytes = data.nbytes
@@ -212,13 +244,28 @@ class ProductCache:
         old = self._ram.pop(fp, None)
         if old is not None:
             self._ram_used -= old[2]
-        while self._ram_used + nbytes > self.ram_bytes and self._ram:
+        self._evict_wire_locked(nbytes)
+        while (self._ram_used + self._wire_used + nbytes > self.ram_bytes
+               and self._ram):
             _, (_, _, b) = self._ram.popitem(last=False)
             self._ram_used -= b
             self.counts["evict.ram"] += 1
             self.timeline.count("cache.evict.ram")
         self._ram[fp] = (header, data, nbytes)
         self._ram_used += nbytes
+
+    def _wire_put_locked(self, fp: str, body: bytes) -> None:
+        """RAM leg of the wire tier: evicts only OTHER wire bodies —
+        never a product entry — and declines when products already
+        fill the budget (the body stays derivable)."""
+        nbytes = len(body)
+        old = self._wire.pop(fp, None)
+        if old is not None:
+            self._wire_used -= old[1]
+        self._evict_wire_locked(nbytes)
+        if self._ram_used + self._wire_used + nbytes <= self.ram_bytes:
+            self._wire[fp] = (bytes(body), nbytes)
+            self._wire_used += nbytes
 
     # -- disk tier ---------------------------------------------------------
     def _disk_publish(self, fp: str, header: Dict, data: np.ndarray,
@@ -270,7 +317,8 @@ class ProductCache:
                     pass
 
     def _disk_evict(self, fp: str, reason: str) -> None:
-        for p in (self.meta_path(fp), self.data_path(fp)):
+        for p in (self.meta_path(fp), self.data_path(fp),
+                  self.wire_path(fp)):
             try:
                 os.unlink(p)
             except OSError:
@@ -306,8 +354,15 @@ class ProductCache:
                 if now_ns - st.st_mtime_ns > 60 * 10**9:
                     self._disk_evict(fp, "disk")  # crash-orphaned data
                 continue
-            entries.append((st.st_mtime_ns, fp, st.st_size))
-            total += st.st_size
+            size = st.st_size
+            try:
+                # The entry's wire body is budgeted (and evicted) with
+                # its product file.
+                size += os.path.getsize(self.wire_path(fp))
+            except OSError:
+                pass
+            entries.append((st.st_mtime_ns, fp, size))
+            total += size
         entries.sort()
         while entries and total + incoming > self.disk_bytes:
             _, fp, size = entries.pop(0)
@@ -365,6 +420,105 @@ class ProductCache:
             self._disk_evict(fp, "corrupt")
             return None
         return meta["header"], _frozen(data)
+
+    # -- encoded wire bodies (ISSUE 16 tentpole #3) ------------------------
+    def _wire_publish(self, fp: str, body: bytes) -> None:
+        """Atomic ``<fp>.wire`` spill: frame bytes + big-endian CRC32
+        footer, write-temp-``os.replace`` — a disk wire hit streams
+        these bytes back without materializing the array."""
+        suffix = f".tmp.{os.getpid()}.{threading.get_ident()}"
+        tmp = self.wire_path(fp) + suffix
+        crc = zlib.crc32(body) & 0xFFFFFFFF
+        try:
+            with open(tmp, "wb") as f:
+                f.write(body)
+                f.write(crc.to_bytes(4, "big"))
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.wire_path(fp))
+        finally:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    def _wire_load(self, fp: str) -> Optional[bytes]:
+        """Read + CRC-verify a ``.wire`` file (PR 12 discipline: the
+        footer guards every load unless ``BLIT_VERIFY_CACHE=0``); a
+        failing body is unlinked and counted ``evict.corrupt`` —
+        the PRODUCT entry, verified separately, stays servable."""
+        from blit import integrity
+
+        path = self.wire_path(fp)
+        try:
+            with open(path, "rb") as f:
+                blob = f.read()
+        except OSError:
+            return None
+        ok = len(blob) >= 4
+        if ok and integrity.cache_verify_enabled():
+            t0 = time.perf_counter()
+            ok = ((zlib.crc32(blob[:-4]) & 0xFFFFFFFF)
+                  == int.from_bytes(blob[-4:], "big"))
+            integrity.observe_verify(time.perf_counter() - t0,
+                                     self.timeline)
+        if not ok:
+            integrity.incr("integrity.cache.corrupt")
+            log.warning("wire body %s fails its CRC footer; evicting",
+                        fp[:16])
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            self._count("evict.corrupt")
+            return None
+        return blob[:-4]
+
+    def get_wire(self, fp: str) -> Optional[Tuple[bytes, str]]:
+        """``(encoded wire body, tier)`` for an entry whose framed form
+        is retained (``tier`` in ``("ram", "disk")``), or ``None`` —
+        which is NOT counted as a miss: the caller falls back to
+        :meth:`get` (which counts), so per-tier accounting stays
+        single-entry.  Hits count ``hit.ram``/``hit.disk`` like any
+        other hit, plus ``hit.wire`` naming the fast path taken."""
+        with self._lock:
+            hit = self._wire.get(fp)
+            if hit is not None:
+                self._wire.move_to_end(fp)
+                self.counts["hit.ram"] += 1
+                self.counts["hit.wire"] += 1
+                self._note_hit_locked(fp)
+                self.timeline.count("cache.hit.ram")
+                self.timeline.count("cache.hit.wire")
+                return hit[0], "ram"
+        if self.root is None:
+            return None
+        body = self._wire_load(fp)
+        if body is None:
+            return None
+        with self._lock:
+            self._wire_put_locked(fp, body)
+            self.counts["hit.disk"] += 1
+            self.counts["hit.wire"] += 1
+            self._note_hit_locked(fp)
+        self.timeline.count("cache.hit.disk")
+        self.timeline.count("cache.hit.wire")
+        return body, "disk"
+
+    def put_wire(self, fp: str, body: bytes) -> None:
+        """Retain the already-encoded wire body of a completed entry:
+        the next binary-wire hit is one memoryview write — no
+        re-encode, no ndarray copy.  RAM (shared budget, wire-first
+        eviction, never displacing a product) then disk spill; a
+        failed spill is logged and dropped — the body is re-derivable
+        from its product, so losing it costs one future encode."""
+        with self._lock:
+            self._wire_put_locked(fp, body)
+        if self.root is not None:
+            try:
+                self._wire_publish(fp, body)
+            except OSError as e:
+                log.warning("wire spill of %s failed: %s", fp[:16], e)
 
     # -- public surface ----------------------------------------------------
     def get(self, fp: str) -> Optional[Tuple[Dict, np.ndarray, str]]:
@@ -458,6 +612,11 @@ class ProductCache:
         except (OSError, ValueError, KeyError, TypeError):
             ok = False  # torn meta / missing data: fail closed
         if ok:
+            # The derived wire body is scrubbed alongside its product:
+            # a failing footer costs ONLY the encoded copy (unlinked,
+            # counted) — the verified product entry stays servable.
+            if os.path.exists(self.wire_path(fp)):
+                self._wire_load(fp)
             return True
         integrity.incr("integrity.cache.corrupt")
         log.warning("cache entry %s failed verification; %s", fp[:16],
@@ -465,10 +624,17 @@ class ProductCache:
         if quarantine:
             integrity.quarantine_move(
                 [self.data_path(fp), mpath], self.root)
+            try:
+                os.unlink(self.wire_path(fp))
+            except OSError:
+                pass
             with self._lock:
                 old = self._ram.pop(fp, None)
                 if old is not None:
                     self._ram_used -= old[2]
+                old_wire = self._wire.pop(fp, None)
+                if old_wire is not None:
+                    self._wire_used -= old_wire[1]
             self._count("evict.corrupt")
         else:
             self._disk_evict(fp, "corrupt")
@@ -514,8 +680,23 @@ class ProductCache:
         with self._lock:
             self._ram.clear()
             self._ram_used = 0
+            self._wire.clear()
+            self._wire_used = 0
         for fp in self.index():
             self._disk_evict(fp, "disk")
+        # A wire body can outlive its product entry (RAM-only product,
+        # spilled frame) — sweep stray .wire files too.
+        if self.root:
+            try:
+                names = os.listdir(self.root)
+            except OSError:
+                names = []
+            for n in names:
+                if n.endswith(".wire"):
+                    try:
+                        os.unlink(os.path.join(self.root, n))
+                    except OSError:
+                        pass
 
 
 def _jsonable(header: Dict) -> Dict:

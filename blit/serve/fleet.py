@@ -50,6 +50,7 @@ the lease dir in one poll interval.
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import queue
@@ -74,8 +75,12 @@ from blit.observability import (
 )
 from blit.serve.http import (
     TIER_HEADER,
+    WIRE_CTYPE,
+    ConnectionPool,
     decode_product,
+    decode_product_wire,
     http_json,
+    http_request,
     retry_after_from,
     trace_headers,
     wire_request,
@@ -86,7 +91,12 @@ from blit.serve.scheduler import DeadlineExpired, Overloaded
 log = logging.getLogger("blit.serve.fleet")
 
 # The fleet plane's latency histograms (the MESH_HISTS convention).
-FLEET_HISTS = ("fleet.request_s", "fleet.peer_s", "fleet.detect_s")
+# serialize_s lands on the PEER's timeline (it encodes), the rest on
+# the door's; wire_bytes is a histogram so .total carries the exact
+# byte sum the bench's GB/s needs.
+FLEET_HISTS = ("fleet.request_s", "fleet.peer_s", "fleet.detect_s",
+               "fleet.serialize_s", "fleet.deserialize_s",
+               "fleet.wire_bytes")
 
 
 class FleetError(RuntimeError):
@@ -198,9 +208,17 @@ class FleetFrontDoor:
                                else d["hedge_min_n"])
         self.hot_hits = int(hot_hits if hot_hits is not None
                             else d["hot_hits"])
+        # Hot-path data plane (ISSUE 16): which product wire to ask
+        # peers for ("binary" | "json" — SiteConfig.fleet_wire /
+        # BLIT_FLEET_WIRE), whether to advertise deflate, and the
+        # bounded per-peer keep-alive pool every hop rides.
+        self.wire = str(d["wire"])
+        self._wire_deflate = bool(d["wire_deflate"])
         self.request_timeout_s = float(request_timeout_s)
         self.clock = clock
         self.timeline = timeline if timeline is not None else Timeline()
+        self.pool = ConnectionPool(max_per_peer=d["pool_conns"],
+                                   timeline=self.timeline)
         self.lease_dir = lease_dir
         self.ring = HashRing(peers, vnodes=d["vnodes"],
                              replicas=self.replicas)
@@ -276,7 +294,7 @@ class FleetFrontDoor:
     def _fetch_health(self, p: _Peer) -> None:
         try:
             status, _, body = http_json("GET", p.url, "/healthz",
-                                        timeout=2.0)
+                                        timeout=2.0, pool=self.pool)
             ok = status == 200 and isinstance(body, dict)
             p.last_health = body if ok else None
         except OSError:
@@ -602,20 +620,46 @@ class FleetFrontDoor:
                 doc["deadline_s"] = max(0.0, rem)
             p.requests += 1
             self.timeline.count("fleet.route")
+            req_hdrs = trace_headers(hedge=hedge, rid=rid)
+            req_hdrs["Content-Type"] = "application/json"
+            if self.wire == "binary":
+                # Negotiate the binary product wire (ISSUE 16): a peer
+                # that can't speak it answers legacy JSON — decoded
+                # below either way, bit-identically.
+                req_hdrs["Accept"] = (
+                    f"{WIRE_CTYPE}, application/json")
+                if self._wire_deflate:
+                    req_hdrs["Accept-Encoding"] = "deflate"
             t = time.perf_counter()
             try:
-                status, hdrs, body = http_json(
-                    "POST", p.url, "/product", doc,
+                status, hdrs, payload = http_request(
+                    "POST", p.url, "/product",
+                    body=json.dumps(doc).encode(),
                     timeout=self._fetch_timeout(t0, deadline_s),
-                    headers=trace_headers(hedge=hedge, rid=rid))
+                    headers=req_hdrs, pool=self.pool)
             finally:
                 dt = time.perf_counter() - t
                 p.hist.observe(dt)
                 self.timeline.observe("fleet.peer_s", dt)
             if status == 200:
                 p.breaker.record_success()
-                header, data = decode_product(body)
+                self.timeline.observe("fleet.wire_bytes", len(payload))
+                ctype = (hdrs.get("content-type") or "").lower()
+                t_dec = time.perf_counter()
+                if ctype.startswith(WIRE_CTYPE):
+                    header, data = decode_product_wire(
+                        payload, encoding=hdrs.get("content-encoding"))
+                    self.timeline.count("fleet.wire.binary")
+                else:
+                    header, data = decode_product(json.loads(payload))
+                    self.timeline.count("fleet.wire.json")
+                self.timeline.observe("fleet.deserialize_s",
+                                      time.perf_counter() - t_dec)
                 return header, data, hdrs.get(TIER_HEADER.lower())
+            try:
+                body = json.loads(payload)
+            except ValueError:
+                body = payload.decode("utf-8", "replace")
             msg = (body.get("error") if isinstance(body, dict)
                    else str(body)[:200])
             if status == 503:
@@ -662,7 +706,7 @@ class FleetFrontDoor:
                 try:
                     http_json("POST", p.url, "/warm",
                               {"recipes": recipes}, timeout=5.0,
-                              headers=hdrs)
+                              headers=hdrs, pool=self.pool)
                 except OSError:
                     pass  # warming is best-effort by definition
 
@@ -709,6 +753,8 @@ class FleetFrontDoor:
                       for n, p in sorted(self._peers.items())},
             "ring": self.ring.peers(),
             "replicas": self.replicas,
+            "wire": self.wire,
+            "pool": self.pool.stats(),
             "inflight": inflight,
             "draining": self._draining,
             "hot": [[fp[:16], h] for fp, h in hot],
@@ -754,7 +800,8 @@ class FleetFrontDoor:
         for name, recipes in per_peer.items():
             try:
                 http_json("POST", self._peers[name].url, "/warm",
-                          {"recipes": recipes}, timeout=5.0)
+                          {"recipes": recipes}, timeout=5.0,
+                          pool=self.pool)
                 sent += len(recipes)
             except OSError:
                 pass
@@ -768,6 +815,7 @@ class FleetFrontDoor:
         if self._thread is not None:
             self._thread.join(timeout=2.0)
             self._thread = None
+        self.pool.close()
         if self.request_log is not None:
             self.request_log.close()
 

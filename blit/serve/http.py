@@ -8,7 +8,21 @@ One wire, three speakers:
   :func:`encode_product` / :func:`decode_product` carry the finished
   ``(header, array)`` product as JSON + base64 payload bytes — small
   products by design (the serve layer returns reduced arrays, not raw
-  voltages), so JSON keeps every hop debuggable with ``curl``.
+  voltages), so JSON keeps every hop debuggable with ``curl``.  The
+  hot path speaks ``application/x-blit-product`` instead (ISSUE 16):
+  :func:`encode_product_wire` / :func:`decode_product_wire` frame the
+  same product as a length-prefixed JSON meta document + the raw
+  C-order payload bytes — no base64 size tax, no payload copy on
+  decode — negotiated by ``Accept`` so legacy JSON clients keep
+  working bit-for-bit (``X-Blit-Wire`` on the response says which
+  form answered).
+- **transport** — :func:`http_request` is the byte-exact transport
+  half (one round-trip → status, headers, payload bytes);
+  :func:`http_json` is the codec half layered on top.
+  :class:`ConnectionPool` gives the fleet's hops bounded per-peer
+  keep-alive sockets; transport errors on a reused socket evict it
+  and retry once on a fresh dial, so the PR-13 failover/breaker
+  semantics only ever judge fresh-dial verdicts.
 - :class:`PeerServer` — one serving peer: a
   :class:`~blit.serve.service.ProductService` behind ``POST /product``
   (+ ``/warm`` cache-warm hints, ``/stats``, ``POST /drain``), with the
@@ -43,7 +57,8 @@ import json
 import logging
 import threading
 import time
-from typing import Callable, Dict, Optional, Tuple
+import zlib
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -124,6 +139,119 @@ def decode_product(doc: Dict) -> Tuple[Dict, np.ndarray]:
     return dict(doc["header"]), arr
 
 
+# -- binary product wire (ISSUE 16 tentpole #1) ------------------------------
+#
+# ``application/x-blit-product``: WIRE_MAGIC, a big-endian u32 meta
+# length, the JSON meta document ({"header", "shape", "dtype", "order"}
+# — dtype as numpy's ``.str`` form, e.g. "<f4", so endianness rides the
+# wire explicitly), then the raw C-order payload bytes.  Compared with
+# the JSON+base64 wire: no ~33% base64 size tax, no encode copy on a
+# cached hit (the frame is the cacheable body), and decode is an
+# ``np.frombuffer`` view over the received buffer — zero payload
+# copies on either end.
+
+WIRE_CTYPE = "application/x-blit-product"
+WIRE_HEADER = "X-Blit-Wire"
+WIRE_MAGIC = b"BLW1"
+# A product meta document is a header + shape/dtype — kilobytes.  A
+# frame claiming more is torn or hostile: refuse before allocating.
+WIRE_MAX_META = 4 << 20
+
+
+class WireError(ValueError):
+    """A binary product frame that cannot be trusted: bad magic, a
+    truncated meta/payload, or an implausible meta length."""
+
+
+def encode_product_parts(header: Dict,
+                         data: np.ndarray) -> Tuple[bytes, memoryview]:
+    """The zero-copy form of :func:`encode_product_wire`:
+    ``(prefix bytes, payload buffer)`` with the payload a flat byte
+    memoryview of the (contiguous) array — the server writes both
+    straight to the socket without joining them into one copy."""
+    arr = np.ascontiguousarray(data)
+    meta = json.dumps({
+        "header": {k: (v.item() if isinstance(v, np.generic) else v)
+                   for k, v in dict(header).items()},
+        "shape": list(arr.shape),
+        "dtype": arr.dtype.str,
+        "order": "C",
+    }).encode()
+    if len(meta) > WIRE_MAX_META:
+        raise WireError(f"product meta is {len(meta)} bytes "
+                        f"(cap {WIRE_MAX_META})")
+    prefix = WIRE_MAGIC + len(meta).to_bytes(4, "big") + meta
+    # memoryview.cast refuses zero-size shapes; an empty product's
+    # payload is simply no bytes.
+    payload = (memoryview(b"") if arr.size == 0
+               else memoryview(arr).cast("B"))
+    return prefix, payload
+
+
+def encode_product_wire(header: Dict, data: np.ndarray, *,
+                        deflate: bool = False) -> bytes:
+    """One ``application/x-blit-product`` frame as bytes — the
+    cacheable wire body (ISSUE 16 tentpole #3).  ``deflate``
+    zlib-compresses the WHOLE frame; the response then carries
+    ``Content-Encoding: deflate`` (worth it for compressible products
+    only — float spectra mostly are not, so it defaults off)."""
+    prefix, payload = encode_product_parts(header, data)
+    body = prefix + bytes(payload)
+    if deflate:
+        body = zlib.compress(body, 6)
+    return body
+
+
+def decode_product_wire(buf, *,
+                        encoding: Optional[str] = None
+                        ) -> Tuple[Dict, np.ndarray]:
+    """Inverse of :func:`encode_product_wire` — the array is a
+    READ-ONLY ``np.frombuffer`` view over ``buf``'s payload bytes (the
+    frozen-result contract, with zero payload copies).  Raises
+    :class:`WireError` on a frame that cannot be trusted."""
+    if encoding:
+        if encoding.strip().lower() != "deflate":
+            raise WireError(f"unknown content encoding {encoding!r}")
+        try:
+            buf = zlib.decompress(bytes(buf))
+        except zlib.error as e:
+            raise WireError(f"undecodable deflate frame: {e}") from None
+    view = memoryview(buf)
+    if len(view) < 8 or bytes(view[:4]) != WIRE_MAGIC:
+        raise WireError("not a blit product frame (bad magic)")
+    n = int.from_bytes(view[4:8], "big")
+    if n > WIRE_MAX_META:
+        raise WireError(f"implausible meta length {n} "
+                        f"(cap {WIRE_MAX_META})")
+    if len(view) < 8 + n:
+        raise WireError(f"truncated frame: meta claims {n} bytes, "
+                        f"{max(0, len(view) - 8)} present")
+    try:
+        meta = json.loads(bytes(view[8:8 + n]))
+        dtype = np.dtype(meta["dtype"])
+        shape = tuple(int(s) for s in meta["shape"])
+    except (ValueError, KeyError, TypeError) as e:
+        raise WireError(f"unparseable frame meta: {e}") from None
+    want = dtype.itemsize * int(np.prod(shape, dtype=np.int64))
+    payload = view[8 + n:]
+    if payload.nbytes != want:
+        raise WireError(f"truncated frame: payload is {payload.nbytes} "
+                        f"bytes, {dtype}{shape} needs {want}")
+    arr = np.frombuffer(payload, dtype=dtype).reshape(shape)
+    arr.setflags(write=False)
+    return dict(meta["header"]), arr
+
+
+def wants_binary_product(accept: Optional[str]) -> bool:
+    """Did the request's ``Accept`` header ask for the binary product
+    wire?  Absent/other → the legacy JSON wire, bit-for-bit."""
+    return WIRE_CTYPE in (accept or "")
+
+
+def wants_deflate(accept_encoding: Optional[str]) -> bool:
+    return "deflate" in (accept_encoding or "")
+
+
 def wire_request(request, *, priority: int = 1, client: str = "anon",
                  deadline_s: Optional[float] = None) -> Dict:
     """A :class:`~blit.serve.service.ProductRequest` as one wire
@@ -155,16 +283,138 @@ def request_from_wire(doc: Dict):
 # -- tiny HTTP client --------------------------------------------------------
 
 
-def http_json(method: str, url: str, path: str, doc: Optional[Dict] = None,
-              timeout: float = 10.0,
-              headers: Optional[Dict[str, str]] = None,
-              ) -> Tuple[int, Dict[str, str], object]:
-    """One JSON request to ``url`` (``http://host:port``) →
-    ``(status, headers, parsed body)`` — body is the parsed JSON when
-    the response says so, else the raw text (``/metrics``).  ``headers``
-    adds extra request headers (the trace-context hop).  Raises
-    ``OSError`` on transport failure (refused/reset/timeout), which the
-    front door classifies as a peer failure."""
+class ConnectionPool:
+    """A bounded, thread-safe per-peer keep-alive pool (ISSUE 16
+    tentpole #2) replacing the per-call ``HTTPConnection``:
+    :meth:`request` leases a pooled socket to the target host (LIFO —
+    the warmest socket first), runs one round-trip, and returns the
+    socket when the response allows reuse.  A transport error on a
+    REUSED socket evicts it and retries ONCE on a fresh dial — safe
+    because every fleet POST is idempotent (content-addressed
+    products, best-effort warms) — so breakers and failover only ever
+    judge fresh-dial verdicts, exactly as before pooling.
+    ``fleet.pool.open`` / ``fleet.pool.reuse`` / ``fleet.pool.evict``
+    ride ``timeline``; the ``pool.reuse`` fault point fires on the
+    reused-socket leg only (the ``BLIT_FAULTS`` drill seam —
+    :class:`~blit.faults.InjectedFault` is an ``OSError``, so a bare
+    injected fault IS a mid-flight reset)."""
+
+    def __init__(self, max_per_peer: int = 4, timeline=None):
+        self.max_per_peer = max(1, int(max_per_peer))
+        self.timeline = timeline
+        self._lock = threading.Lock()
+        self._idle: Dict[Tuple[str, int], List] = {}
+        self._closed = False
+
+    def _count(self, name: str) -> None:
+        if self.timeline is not None:
+            self.timeline.count(name)
+
+    def _take(self, key):
+        with self._lock:
+            conns = self._idle.get(key)
+            if conns:
+                return conns.pop()
+        return None
+
+    def _give(self, key, conn) -> None:
+        with self._lock:
+            if not self._closed:
+                conns = self._idle.setdefault(key, [])
+                if len(conns) < self.max_per_peer:
+                    conns.append(conn)
+                    return
+        conn.close()
+
+    def stats(self) -> Dict[str, int]:
+        """Idle sockets per peer — the reuse-ratio denominator lives
+        on the timeline counters; this is the live pool occupancy."""
+        with self._lock:
+            return {f"{h}:{p}": len(c)
+                    for (h, p), c in self._idle.items() if c}
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            conns = [c for lst in self._idle.values() for c in lst]
+            self._idle.clear()
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    def request(self, method: str, url: str, path: str, body=None,
+                headers: Optional[Dict[str, str]] = None,
+                timeout: float = 10.0
+                ) -> Tuple[int, Dict[str, str], bytes]:
+        """One round-trip → ``(status, lower-cased headers, payload
+        bytes)``.  Raises ``OSError`` on fresh-dial transport failure,
+        exactly like an unpooled connection — a reused-socket failure
+        is absorbed by the evict-and-redial retry."""
+        import http.client
+        from urllib.parse import urlsplit
+
+        parts = urlsplit(url)
+        key = (parts.hostname or "127.0.0.1", parts.port or 80)
+        conn = self._take(key)
+        if conn is not None:
+            self._count("fleet.pool.reuse")
+            try:
+                faults.fire("pool.reuse", key=f"{key[0]}:{key[1]}")
+                return self._roundtrip(conn, key, method, path, body,
+                                       headers, timeout)
+            except OSError:
+                # Stale keep-alive (peer restarted, idle timeout,
+                # mid-flight reset): evict, fall through to the dial.
+                self._count("fleet.pool.evict")
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+        conn = http.client.HTTPConnection(key[0], key[1], timeout=timeout)
+        self._count("fleet.pool.open")
+        try:
+            return self._roundtrip(conn, key, method, path, body,
+                                   headers, timeout)
+        except BaseException:
+            conn.close()
+            raise
+
+    def _roundtrip(self, conn, key, method, path, body, headers,
+                   timeout) -> Tuple[int, Dict[str, str], bytes]:
+        # Per-request deadline on a long-lived socket: the connection's
+        # dial timeout is whatever the FIRST request chose — retune it.
+        conn.timeout = timeout
+        if conn.sock is not None:
+            conn.sock.settimeout(timeout)
+        conn.request(method, path, body=body, headers=dict(headers or {}))
+        resp = conn.getresponse()
+        payload = resp.read()
+        hdrs = {k.lower(): v for k, v in resp.getheaders()}
+        if resp.will_close:
+            conn.close()
+        else:
+            self._give(key, conn)
+        return resp.status, hdrs, payload
+
+
+def http_request(method: str, url: str, path: str, body=None,
+                 headers: Optional[Dict[str, str]] = None,
+                 timeout: float = 10.0,
+                 pool: Optional[ConnectionPool] = None,
+                 ) -> Tuple[int, Dict[str, str], bytes]:
+    """One HTTP round-trip to ``url`` (``http://host:port``) →
+    ``(status, lower-cased headers, payload bytes)`` — the byte-exact
+    TRANSPORT half of :func:`http_json` (ISSUE 16 satellite: binary
+    bodies round-trip untouched, no lossy text decode).  ``pool``
+    reuses a :class:`ConnectionPool` keep-alive socket; without one
+    the connection is dialed and closed per call.  Raises ``OSError``
+    on transport failure (refused/reset/timeout), which the front
+    door classifies as a peer failure."""
+    if pool is not None:
+        return pool.request(method, url, path, body=body,
+                            headers=headers, timeout=timeout)
     import http.client
     from urllib.parse import urlsplit
 
@@ -172,23 +422,46 @@ def http_json(method: str, url: str, path: str, doc: Optional[Dict] = None,
     conn = http.client.HTTPConnection(parts.hostname,
                                       parts.port or 80, timeout=timeout)
     try:
-        body = None
-        req_hdrs = dict(headers or {})
-        if doc is not None:
-            body = json.dumps(doc).encode()
-            req_hdrs["Content-Type"] = "application/json"
-        conn.request(method, path, body=body, headers=req_hdrs)
+        conn.request(method, path, body=body, headers=dict(headers or {}))
         resp = conn.getresponse()
         payload = resp.read()
         hdrs = {k.lower(): v for k, v in resp.getheaders()}
-        if "json" in (hdrs.get("content-type") or ""):
-            try:
-                return resp.status, hdrs, json.loads(payload or b"{}")
-            except ValueError:
-                pass
-        return resp.status, hdrs, payload.decode("utf-8", "replace")
+        return resp.status, hdrs, payload
     finally:
         conn.close()
+
+
+def http_json(method: str, url: str, path: str, doc: Optional[Dict] = None,
+              timeout: float = 10.0,
+              headers: Optional[Dict[str, str]] = None,
+              pool: Optional[ConnectionPool] = None,
+              ) -> Tuple[int, Dict[str, str], object]:
+    """One JSON request to ``url`` (``http://host:port``) →
+    ``(status, headers, body)`` — the body is the parsed JSON when the
+    response says so, the raw BYTES for a binary content type (the
+    product wire — never text-decoded), else decoded text
+    (``/metrics``).  ``headers`` adds extra request headers (the
+    trace-context hop); ``pool`` rides a keep-alive socket.  Raises
+    ``OSError`` on transport failure (refused/reset/timeout), which
+    the front door classifies as a peer failure."""
+    req_hdrs = dict(headers or {})
+    body = None
+    if doc is not None:
+        body = json.dumps(doc).encode()
+        req_hdrs["Content-Type"] = "application/json"
+    status, hdrs, payload = http_request(method, url, path, body=body,
+                                         headers=req_hdrs,
+                                         timeout=timeout, pool=pool)
+    ctype = (hdrs.get("content-type") or "").lower()
+    if "json" in ctype:
+        try:
+            return status, hdrs, json.loads(payload or b"{}")
+        except ValueError:
+            pass
+    if ctype.startswith(WIRE_CTYPE) or ctype.startswith(
+            "application/octet"):
+        return status, hdrs, payload
+    return status, hdrs, payload.decode("utf-8", "replace")
 
 
 # -- shared server skeleton --------------------------------------------------
@@ -206,6 +479,11 @@ def _make_server(router: Callable, port: int, host: str = "127.0.0.1"):
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     class Handler(BaseHTTPRequestHandler):
+        # HTTP/1.1 keep-alive (ISSUE 16): the fleet's ConnectionPool
+        # reuses sockets across requests.  Safe with the stdlib
+        # handler because every response carries Content-Length.
+        protocol_version = "HTTP/1.1"
+
         def _route(self, method: str):
             try:
                 doc = None
@@ -225,14 +503,22 @@ def _make_server(router: Callable, port: int, host: str = "127.0.0.1"):
                     500, json.dumps({"error": str(e),
                                      "etype": type(e).__name__}),
                     "application/json", {})
-            blob = body.encode() if isinstance(body, str) else body
+            if isinstance(body, tuple):
+                # Zero-copy wire body (ISSUE 16): (prefix bytes,
+                # payload buffer) written straight through — the
+                # product's bytes are never joined into one copy.
+                parts = list(body)
+            else:
+                parts = [body.encode() if isinstance(body, str) else body]
             self.send_response(status)
             self.send_header("Content-Type", ctype)
-            self.send_header("Content-Length", str(len(blob)))
+            self.send_header("Content-Length",
+                             str(sum(len(p) for p in parts)))
             for k, v in (extra or {}).items():
                 self.send_header(k, str(v))
             self.end_headers()
-            self.wfile.write(blob)
+            for p in parts:
+                self.wfile.write(p)
 
         def do_GET(self):  # noqa: N802 — stdlib contract
             self._route("GET")
@@ -243,9 +529,47 @@ def _make_server(router: Callable, port: int, host: str = "127.0.0.1"):
         def log_message(self, fmt, *args):  # quiet request traffic
             log.debug("http: " + fmt, *args)
 
-    server = ThreadingHTTPServer((host, int(port)), Handler)
-    server.daemon_threads = True
-    return server
+    class Server(ThreadingHTTPServer):
+        """Tracks live connections so ``close_all_connections`` can
+        sever keep-alive sockets: with HTTP/1.1, closing the listener
+        alone would leave a "dead" server still answering pooled
+        clients through established connections."""
+
+        daemon_threads = True
+
+        def __init__(self, *a, **kw):
+            self._conns = set()
+            self._conns_lock = threading.Lock()
+            super().__init__(*a, **kw)
+
+        def get_request(self):
+            sock, addr = super().get_request()
+            with self._conns_lock:
+                self._conns.add(sock)
+            return sock, addr
+
+        def shutdown_request(self, request):
+            with self._conns_lock:
+                self._conns.discard(request)
+            super().shutdown_request(request)
+
+        def close_all_connections(self):
+            import socket as _socket
+
+            with self._conns_lock:
+                conns = list(self._conns)
+                self._conns.clear()
+            for s in conns:
+                try:
+                    s.shutdown(_socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    s.close()
+                except OSError:
+                    pass
+
+    return Server((host, int(port)), Handler)
 
 
 def _json_resp(status: int, doc: Dict,
@@ -312,6 +636,13 @@ class PeerServer:
         self.service = service
         self.name = name
         self.request_timeout_s = float(request_timeout_s)
+        # Whole-frame deflate on the binary wire, only when BOTH the
+        # client advertises it and the knob says so (off by default:
+        # float spectra compress poorly and the CPU tax lands on the
+        # hot path).
+        from blit.config import fleet_defaults
+
+        self._wire_deflate = bool(fleet_defaults(config)["wire_deflate"])
         # Per-request access records (ISSUE 15 tentpole #2): one line
         # per handled /product with trace id, tier outcome, queue wait
         # and status — None (one attribute test per request) unless
@@ -380,6 +711,21 @@ class PeerServer:
             return _json_resp(200, {"draining": True})
         return _json_resp(404, {"error": f"no route {method} {path}"})
 
+    def _wire_resp(self, body: bytes, tier: Optional[str], rid: str,
+                   deflate: bool) -> Tuple:
+        """One binary-wire 200: the already-encoded frame (optionally
+        whole-frame deflated), ``X-Blit-Wire: binary`` naming the
+        negotiated form, and the tier/rid headers as on the JSON
+        wire."""
+        extra = {TIER_HEADER: tier, REQUEST_ID_HEADER: rid,
+                 WIRE_HEADER: "binary"}
+        if deflate:
+            body = zlib.compress(body, 6)
+            extra["Content-Encoding"] = "deflate"
+        self.service.timeline.count("serve.wire.binary")
+        self.service.timeline.observe("fleet.wire_bytes", len(body))
+        return 200, body, WIRE_CTYPE, extra
+
     def _handle_product(self, doc: Dict, headers: Dict) -> Tuple:
         with self._counts_lock:
             self.counts["product"] += 1
@@ -391,9 +737,13 @@ class PeerServer:
         ctx = trace_context_from(headers)
         hedge = headers.get(HEDGE_HEADER.lower()) == "1"
         rid = headers.get(REQUEST_ID_HEADER.lower()) or observability.new_id()
+        binary = wants_binary_product(headers.get("accept"))
+        deflate = (binary and self._wire_deflate
+                   and wants_deflate(headers.get("accept-encoding")))
         tr = observability.tracer()
         t0 = time.perf_counter()
         status, code, ticket, nbytes = "error", 500, None, 0
+        fp = tier = qwait = None
         priority = client = deadline_s = None
         try:
             with tr.activate(ctx):
@@ -401,6 +751,16 @@ class PeerServer:
                 # The chaos schedule's injection point: kill/hang/delay
                 # THIS peer on the Nth handled request (chaos --fleet).
                 faults.fire("peer.request", key=str(req.raw_source))
+                if binary:
+                    # The encoded-body fast path (ISSUE 16 tentpole
+                    # #3): a retained wire body answers without
+                    # re-encoding — or even materializing — the array.
+                    hit = self.service.wire_for(req)
+                    if hit is not None:
+                        fp, body, tier = hit
+                        nbytes = len(body)
+                        status, code = "ok", 200
+                        return self._wire_resp(body, tier, rid, deflate)
                 timeout = (min(self.request_timeout_s, deadline_s)
                            if deadline_s is not None
                            else self.request_timeout_s)
@@ -427,9 +787,28 @@ class PeerServer:
                         f"mid-compute: {e}") from e
             nbytes = data.nbytes
             status, code = "ok", 200
-            return _json_resp(200, encode_product(header, data),
-                              {TIER_HEADER: ticket.source,
-                               REQUEST_ID_HEADER: rid})
+            fp, tier = ticket.fingerprint, ticket.source
+            qwait = round(ticket.queue_wait_s(), 6)
+            if binary:
+                t_enc = time.perf_counter()
+                body = encode_product_wire(header, data)
+                self.service.timeline.observe(
+                    "fleet.serialize_s", time.perf_counter() - t_enc)
+                # Retain the encoded body: the NEXT binary hit for
+                # this fingerprint skips the encode entirely.
+                self.service.cache.put_wire(fp, body)
+                return self._wire_resp(body, tier, rid, deflate)
+            t_enc = time.perf_counter()
+            resp = _json_resp(200, encode_product(header, data),
+                              {TIER_HEADER: tier,
+                               REQUEST_ID_HEADER: rid,
+                               WIRE_HEADER: "json"})
+            self.service.timeline.observe(
+                "fleet.serialize_s", time.perf_counter() - t_enc)
+            self.service.timeline.count("serve.wire.json")
+            self.service.timeline.observe("fleet.wire_bytes",
+                                          len(resp[1]))
+            return resp
         except BaseException as e:  # noqa: BLE001 — mapped onto the wire
             from blit.serve.scheduler import classify_failure
 
@@ -443,13 +822,15 @@ class PeerServer:
         finally:
             if self.request_log is not None:
                 dt = time.perf_counter() - t0
+                if fp is None and ticket is not None:
+                    # A failed flight still records its routing truth.
+                    fp, tier = ticket.fingerprint, ticket.source
+                    qwait = round(ticket.queue_wait_s(), 6)
                 self.request_log.record(
                     rid=rid, trace=(ctx or {}).get("trace"), role="peer",
                     peer=self.name, client=client, priority=priority,
-                    fp=(ticket.fingerprint[:16] if ticket else None),
-                    tier=(ticket.source if ticket else None),
-                    queue_wait_s=(round(ticket.queue_wait_s(), 6)
-                                  if ticket else None),
+                    fp=(fp[:16] if fp else None),
+                    tier=tier, queue_wait_s=qwait,
                     deadline_s=deadline_s,
                     deadline_left_s=(round(deadline_s - dt, 6)
                                      if deadline_s is not None else None),
@@ -478,8 +859,12 @@ class PeerServer:
                 except Exception:  # noqa: BLE001 — warming is best-effort
                     rejected += 1
         self.service.timeline.count("serve.warm", accepted)
+        # /warm negotiates like /product (ISSUE 16) — its 202 body is
+        # JSON either way (recipes in, counts out: nothing to frame),
+        # so the header honestly answers "json" even to binary askers.
         return _json_resp(202, {"accepted": accepted,
-                                "rejected": rejected})
+                                "rejected": rejected},
+                          {WIRE_HEADER: "json"})
 
     # -- surfaces ----------------------------------------------------------
     def health(self) -> Dict:
@@ -542,6 +927,7 @@ class PeerServer:
             self._beat_thread = None
         self._server.shutdown()
         self._server.server_close()
+        self._server.close_all_connections()
         self._server_thread = None
         self._pub.close()
         if self.request_log is not None:
@@ -598,6 +984,7 @@ class FrontDoorServer:
             # fleet.request span — and everything downstream — parents
             # onto it.
             tr = observability.tracer()
+            binary = wants_binary_product((headers or {}).get("accept"))
             try:
                 with tr.activate(trace_context_from(headers)):
                     req, priority, client, deadline_s = request_from_wire(
@@ -607,7 +994,14 @@ class FrontDoorServer:
                         deadline_s=deadline_s)
             except BaseException as e:  # noqa: BLE001 — mapped
                 return _error_resp(e)
-            return _json_resp(200, encode_product(header, data))
+            if binary:
+                # Zero-copy to the client: prefix + payload buffer
+                # written straight through (_make_server), no joined
+                # body copy of the product bytes.
+                return (200, encode_product_parts(header, data),
+                        WIRE_CTYPE, {WIRE_HEADER: "binary"})
+            return _json_resp(200, encode_product(header, data),
+                              {WIRE_HEADER: "json"})
         if method == "POST" and path.startswith("/drain"):
             threading.Thread(target=self.door.drain,
                              name="blit-door-drain", daemon=True).start()
@@ -625,6 +1019,7 @@ class FrontDoorServer:
     def close(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+        self._server.close_all_connections()
         self._server_thread = None
 
     def __enter__(self):
@@ -718,6 +1113,7 @@ def retry_after_from(headers: Dict[str, str], body: object) -> float:
 
 
 __all__ = [
+    "ConnectionPool",
     "FrontDoorServer",
     "HEDGE_HEADER",
     "PeerServer",
@@ -725,14 +1121,22 @@ __all__ = [
     "SPAN_HEADER",
     "TIER_HEADER",
     "TRACE_HEADER",
+    "WIRE_CTYPE",
+    "WIRE_HEADER",
+    "WireError",
     "decode_product",
+    "decode_product_wire",
     "encode_product",
+    "encode_product_parts",
+    "encode_product_wire",
     "http_json",
+    "http_request",
     "install_drain_handler",
     "request_from_wire",
     "retry_after_from",
     "trace_context_from",
     "trace_headers",
     "wait_http_ready",
+    "wants_binary_product",
     "wire_request",
 ]

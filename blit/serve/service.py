@@ -425,6 +425,27 @@ class ProductService:
             self.counts["scheduled"] += 1
         return t
 
+    def wire_for(self, request: ProductRequest
+                 ) -> Optional[Tuple[str, bytes, str]]:
+        """The already-encoded binary wire body for ``request`` when
+        the cache retains one (ISSUE 16): ``(fingerprint, frame bytes,
+        tier)``, or ``None`` — a miss here is NOT a cache miss; the
+        caller falls back to :meth:`submit`, which counts and serves.
+        A draining service answers ``None`` too, so the refusal runs
+        through submit's :class:`Overloaded` → 503 contract unchanged.
+        """
+        if self._draining or request.kind == "stream":
+            return None
+        fp = fingerprint_for(request.reducer(), request.raw_source)
+        hit = self.cache.get_wire(fp)
+        if hit is None:
+            return None
+        body, tier = hit
+        with self._lock:
+            self.counts["requests"] += 1
+            self.counts["cache_hits"] += 1
+        return fp, body, tier
+
     def _submit_stream(self, request: ProductRequest, priority: int,
                        client: str) -> Ticket:
         """Admit a LIVE job (ISSUE 12 satellite): no cache hit is

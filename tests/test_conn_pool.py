@@ -1,0 +1,190 @@
+"""The fleet's keep-alive :class:`ConnectionPool` (ISSUE 16): sockets
+reused across requests, a mid-flight reset (``BLIT_FAULTS``-style
+``pool.reuse`` injection) evicts the pooled socket and redials fresh so
+the caller never sees the stale connection, bodies never bleed across
+concurrent requests, and the idle set stays bounded."""
+
+import json
+import threading
+
+import pytest
+
+pytest.importorskip("jax")
+
+from blit import faults  # noqa: E402
+from blit.faults import FaultRule  # noqa: E402
+from blit.observability import Timeline  # noqa: E402
+from blit.serve.http import (  # noqa: E402
+    ConnectionPool,
+    _make_server,
+    http_json,
+    http_request,
+)
+
+
+@pytest.fixture
+def echo_server():
+    """A keep-alive server that echoes the request body (and tags the
+    serving path) — the bleed/byte-exactness oracle."""
+
+    def router(method, path, doc, headers):
+        body = json.dumps({"path": path, "doc": doc})
+        if path.startswith("/bytes/"):
+            # Raw binary body, length from the path: byte-exactness.
+            n = int(path.rsplit("/", 1)[1])
+            return 200, bytes(range(256)) * (n // 256 + 1), \
+                "application/octet-stream", {}
+        return 200, body, "application/json", {}
+
+    server = _make_server(router, 0)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{server.server_address[1]}"
+    yield url
+    server.shutdown()
+    server.close_all_connections()
+    server.server_close()
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    faults.reset_counters()
+    yield
+    faults.clear()
+
+
+class TestReuse:
+    def test_second_request_reuses_the_socket(self, echo_server):
+        tl = Timeline()
+        pool = ConnectionPool(max_per_peer=4, timeline=tl)
+        try:
+            for i in range(3):
+                st, _, doc = http_json("POST", echo_server, "/e",
+                                       {"i": i}, pool=pool)
+                assert st == 200 and doc["doc"] == {"i": i}
+            rep = tl.report()
+            assert rep["fleet.pool.open"]["calls"] == 1
+            assert rep["fleet.pool.reuse"]["calls"] == 2
+            assert sum(pool.stats().values()) == 1
+        finally:
+            pool.close()
+
+    def test_idle_set_is_bounded(self, echo_server):
+        pool = ConnectionPool(max_per_peer=2, timeline=Timeline())
+        try:
+            n = 6
+            barrier = threading.Barrier(n)
+            errs = []
+
+            def worker():
+                try:
+                    barrier.wait(timeout=10)
+                    st, _, _ = http_json("GET", echo_server, "/x",
+                                         pool=pool)
+                    assert st == 200
+                except Exception as e:  # noqa: BLE001 — collected
+                    errs.append(repr(e))
+
+            ts = [threading.Thread(target=worker) for _ in range(n)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            assert not errs
+            assert sum(pool.stats().values()) <= 2
+        finally:
+            pool.close()
+
+    def test_close_empties_the_pool(self, echo_server):
+        pool = ConnectionPool(timeline=Timeline())
+        http_json("GET", echo_server, "/x", pool=pool)
+        assert sum(pool.stats().values()) == 1
+        pool.close()
+        assert sum(pool.stats().values()) == 0
+        # A closed-then-reused pool still serves (fresh dial).
+        st, _, _ = http_json("GET", echo_server, "/x", pool=pool)
+        assert st == 200
+        pool.close()
+
+
+class TestFaults:
+    def test_reset_on_reuse_evicts_and_redials(self, echo_server):
+        # The BLIT_FAULTS drill: the pooled socket dies between
+        # requests (peer restarted, LB idle-timeout).  The pool must
+        # absorb exactly that — evict, redial fresh, serve — without
+        # surfacing the reset to the caller.
+        tl = Timeline()
+        pool = ConnectionPool(max_per_peer=4, timeline=tl)
+        try:
+            http_json("GET", echo_server, "/warmup", pool=pool)
+            faults.install(FaultRule(point="pool.reuse",
+                                     exc=ConnectionResetError))
+            st, _, doc = http_json("POST", echo_server, "/after",
+                                   {"ok": 1}, pool=pool)
+            assert st == 200 and doc["doc"] == {"ok": 1}
+            rep = tl.report()
+            assert rep["fleet.pool.evict"]["calls"] == 1
+            assert rep["fleet.pool.open"]["calls"] == 2  # warmup+redial
+        finally:
+            pool.close()
+
+    def test_fresh_dial_failure_propagates(self):
+        # Only the REUSED leg retries: a dead peer stays an error the
+        # breaker/failover layer above must see (PR-13 semantics).
+        pool = ConnectionPool(timeline=Timeline())
+        try:
+            with pytest.raises(OSError):
+                http_request("GET", "http://127.0.0.1:9", "/x",
+                             timeout=0.5, pool=pool)
+        finally:
+            pool.close()
+
+
+class TestNoBodyBleed:
+    def test_concurrent_distinct_bodies(self, echo_server):
+        # Many threads hammer one pool with distinct payloads; every
+        # response must match ITS request — a pooled socket handed to
+        # two requests at once (or a stale buffered body) would
+        # scramble this.
+        pool = ConnectionPool(max_per_peer=3, timeline=Timeline())
+        errs = []
+
+        def worker(wid):
+            try:
+                for i in range(8):
+                    st, _, doc = http_json(
+                        "POST", echo_server, f"/w{wid}",
+                        {"wid": wid, "i": i}, pool=pool)
+                    assert st == 200
+                    assert doc["path"] == f"/w{wid}"
+                    assert doc["doc"] == {"wid": wid, "i": i}
+            except Exception as e:  # noqa: BLE001 — collected
+                errs.append(repr(e))
+
+        try:
+            ts = [threading.Thread(target=worker, args=(w,))
+                  for w in range(6)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            assert not errs
+        finally:
+            pool.close()
+
+    def test_binary_bodies_byte_exact_over_reused_socket(
+            self, echo_server):
+        # The transport/codec split satellite: http_request must
+        # round-trip non-JSON bodies byte-exact — including over a
+        # REUSED socket, where a length bug would bleed into the next
+        # response.
+        pool = ConnectionPool(timeline=Timeline())
+        try:
+            for n in (256, 1024, 512):
+                st, hdrs, payload = http_request(
+                    "GET", echo_server, f"/bytes/{n}", pool=pool)
+                assert st == 200
+                want = bytes(range(256)) * (n // 256 + 1)
+                assert payload == want
+        finally:
+            pool.close()

@@ -25,9 +25,13 @@ from blit.serve import (  # noqa: E402
 )
 from blit.serve.cache import fingerprint_for  # noqa: E402
 from blit.serve.http import (  # noqa: E402
+    WIRE_CTYPE,
+    WIRE_HEADER,
     decode_product,
+    decode_product_wire,
     encode_product,
     http_json,
+    http_request,
     request_from_wire,
     retry_after_from,
     wire_request,
@@ -234,3 +238,74 @@ class TestConcurrentHTTP:
         assert len(set(results)) == 1  # byte-identical for every caller
         # Single-flight + cache: at most one reduction was scheduled.
         assert peer.service.counts["scheduled"] == 1
+
+
+class TestBinaryWireNegotiation:
+    BIN_ACCEPT = {"Accept": f"{WIRE_CTYPE}, application/json",
+                  "Content-Type": "application/json"}
+
+    def post(self, peer, req, headers=None):
+        import json as _json
+
+        return http_request(
+            "POST", peer.url, "/product",
+            body=_json.dumps(wire_request(req)).encode(),
+            headers=headers or {"Content-Type": "application/json"},
+            timeout=120)
+
+    def test_binary_accept_negotiates_binary(self, peer, raw):
+        req = ProductRequest(raw=raw, nfft=NFFT, nint=1)
+        status, hdrs, payload = self.post(peer, req, self.BIN_ACCEPT)
+        assert status == 200
+        assert hdrs["content-type"].startswith(WIRE_CTYPE)
+        assert hdrs[WIRE_HEADER.lower()] == "binary"
+        _, via_wire = decode_product_wire(payload)
+        _, direct = peer.service.get(req, timeout=120)
+        assert via_wire.dtype == direct.dtype
+        assert via_wire.tobytes() == direct.tobytes()
+
+    def test_legacy_client_untouched(self, peer, raw):
+        # No binary Accept -> the exact JSON+base64 wire as before,
+        # now self-labelling via X-Blit-Wire: json.
+        import json as _json
+
+        req = ProductRequest(raw=raw, nfft=NFFT, nint=1)
+        status, hdrs, payload = self.post(peer, req)
+        assert status == 200
+        assert hdrs["content-type"].startswith("application/json")
+        assert hdrs[WIRE_HEADER.lower()] == "json"
+        _, via_json = decode_product(_json.loads(payload))
+        _, direct = peer.service.get(req, timeout=120)
+        assert via_json.tobytes() == direct.tobytes()
+
+    def test_both_wires_byte_identical(self, peer, raw):
+        import json as _json
+
+        req = ProductRequest(raw=raw, nfft=NFFT, nint=1)
+        _, _, pj = self.post(peer, req)
+        _, hb, pb = self.post(peer, req, self.BIN_ACCEPT)
+        hj_h, dj = decode_product(_json.loads(pj))
+        hb_h, db = decode_product_wire(pb)
+        assert hj_h == hb_h
+        assert dj.dtype == db.dtype and dj.shape == db.shape
+        assert dj.tobytes() == db.tobytes()
+
+    def test_second_binary_hit_serves_from_wire_tier(self, peer, raw):
+        req = ProductRequest(raw=raw, nfft=NFFT, nint=1)
+        _, _, p1 = self.post(peer, req, self.BIN_ACCEPT)
+        before = peer.service.cache.stats().get("hit.wire", 0)
+        _, _, p2 = self.post(peer, req, self.BIN_ACCEPT)
+        assert p1 == p2  # the retained body IS the first response
+        assert peer.service.cache.stats()["hit.wire"] > before
+
+    def test_deflate_negotiated_when_enabled(self, peer, raw):
+        peer._wire_deflate = True
+        req = ProductRequest(raw=raw, nfft=NFFT, nint=1)
+        hdrs_in = dict(self.BIN_ACCEPT)
+        hdrs_in["Accept-Encoding"] = "deflate"
+        status, hdrs, payload = self.post(peer, req, hdrs_in)
+        assert status == 200
+        assert hdrs.get("content-encoding") == "deflate"
+        _, d = decode_product_wire(payload, encoding="deflate")
+        _, direct = peer.service.get(req, timeout=120)
+        assert d.tobytes() == direct.tobytes()

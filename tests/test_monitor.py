@@ -593,6 +593,48 @@ class TestBenchDiff:
         assert good["metrics"]["ingest_GBps"]["status"] == "improved"
         assert good["verdict"] == "pass"
 
+    def test_serve_record_metrics_dict_extraction(self):
+        # ISSUE 16: serve-bench --archive-day records carry a flat
+        # "metrics" dict — hit rate / GB/s / speedup plus latency
+        # quantiles — which bench_metrics ingests directly.
+        rep = {"serve_bench": "archive-day",
+               "config": {"backend": "cpu"},
+               "metrics": {"fleet_hit_rate": 0.94,
+                           "fleet_wire_gbps": 0.028,
+                           "wire_speedup": 1.12,
+                           "fleet_request_p99_s": 1.5,
+                           "not_a_metric": 7.0,
+                           "errors": "nope"}}
+        m = bench_metrics(rep)
+        assert m == {"fleet_hit_rate": 0.94, "fleet_wire_gbps": 0.028,
+                     "wire_speedup": 1.12, "fleet_request_p99_s": 1.5}
+
+    def test_latency_quantiles_invert_the_band(self):
+        # Lower-is-better: a p99 RISING above the noise band regresses;
+        # dropping below it improves.  Higher-is-better metrics in the
+        # same record keep their direction.
+        def rec(p99, hr=0.9):
+            return {"config": {"backend": "cpu"},
+                    "metrics": {"fleet_request_p99_s": p99,
+                                "fleet_hit_rate": hr}}
+
+        baselines = [rec(1.0), rec(1.2)]
+        worse = bench_diff(rec(2.0), baselines, rel_tol=0.2)
+        assert worse["metrics"]["fleet_request_p99_s"][
+            "status"] == "regress"
+        assert worse["verdict"] == "regress"
+        better = bench_diff(rec(0.5), baselines, rel_tol=0.2)
+        assert better["metrics"]["fleet_request_p99_s"][
+            "status"] == "improved"
+        assert better["verdict"] == "pass"
+        inside = bench_diff(rec(1.1), baselines, rel_tol=0.2)
+        assert inside["metrics"]["fleet_request_p99_s"][
+            "status"] == "ok"
+        # The higher-is-better metric still regresses from BELOW.
+        low_hr = bench_diff(rec(1.0, hr=0.2), baselines, rel_tol=0.2)
+        assert low_hr["metrics"]["fleet_hit_rate"][
+            "status"] == "regress"
+
     def test_rig_filter_excludes_other_backends(self):
         tpu = dict(self.BASE, value=100.0,
                    config={"backend": "tpu", "name": "tpu"})

@@ -1,0 +1,223 @@
+"""The binary product wire (ISSUE 16): ``application/x-blit-product``
+round-trips byte-exact across dtypes/shapes/endianness, rejects
+malformed frames with :class:`WireError`, stays bit-identical to the
+legacy JSON+base64 wire, and the encoded-body cache tier serves/spills/
+CRC-guards the framed bytes."""
+
+import zlib
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+from blit.observability import Timeline  # noqa: E402
+from blit.serve import ProductCache  # noqa: E402
+from blit.serve.http import (  # noqa: E402
+    WIRE_MAGIC,
+    WIRE_MAX_META,
+    WireError,
+    decode_product,
+    decode_product_wire,
+    encode_product,
+    encode_product_parts,
+    encode_product_wire,
+    wants_binary_product,
+)
+
+HDR = {"nchans": 4, "tsamp": 1e-5, "src": "unit"}
+
+
+class TestWireRoundTrip:
+    @pytest.mark.parametrize("dtype", [
+        np.float32, np.float64, np.int16, np.uint8, np.complex64,
+    ])
+    def test_dtypes_byte_exact(self, dtype):
+        data = (np.arange(24).reshape(2, 3, 4) * 0.37).astype(dtype)
+        h2, d2 = decode_product_wire(encode_product_wire(HDR, data))
+        assert h2 == HDR
+        assert d2.dtype == data.dtype
+        assert d2.shape == data.shape
+        assert d2.tobytes() == data.tobytes()
+        assert not d2.flags.writeable  # the frozen-result contract
+
+    def test_big_endian_carried_explicitly(self):
+        # Endianness rides in the frame's dtype string (">f4"), not in
+        # any ambient convention: a big-endian array decodes back
+        # big-endian, byte-for-byte.
+        data = np.arange(12, dtype=">f4").reshape(3, 4)
+        h2, d2 = decode_product_wire(encode_product_wire(HDR, data))
+        assert d2.dtype.str == ">f4"
+        assert d2.tobytes() == data.tobytes()
+
+    def test_zero_length(self):
+        data = np.zeros((0, 7), dtype=np.float32)
+        _, d2 = decode_product_wire(encode_product_wire(HDR, data))
+        assert d2.shape == (0, 7)
+        assert d2.nbytes == 0
+
+    def test_non_c_order_input(self):
+        # Fortran-order input is re-laid C-order on encode; the decoded
+        # VALUES are identical even though the original buffer isn't.
+        data = np.asfortranarray(
+            np.arange(24, dtype=np.float32).reshape(4, 6))
+        _, d2 = decode_product_wire(encode_product_wire(HDR, data))
+        assert np.array_equal(d2, data)
+
+    def test_header_numpy_scalars_become_plain_json(self):
+        hdr = {"foff": np.float64(-2.9), "nbits": np.int32(32)}
+        h2, _ = decode_product_wire(
+            encode_product_wire(hdr, np.ones(3, np.float32)))
+        assert h2 == {"foff": -2.9, "nbits": 32}
+
+    def test_deflate_roundtrip(self):
+        data = np.zeros((64, 64), dtype=np.float32)  # compressible
+        body = encode_product_wire(HDR, data, deflate=True)
+        assert len(body) < data.nbytes
+        _, d2 = decode_product_wire(body, encoding="deflate")
+        assert d2.tobytes() == data.tobytes()
+
+    def test_parts_concatenation_equals_whole_frame(self):
+        # The zero-copy server path writes (prefix, memoryview) — their
+        # concatenation must be the exact frame the one-shot encoder
+        # produces.
+        data = np.arange(10, dtype=np.float32)
+        prefix, payload = encode_product_parts(HDR, data)
+        assert prefix + bytes(payload) == encode_product_wire(HDR, data)
+
+
+class TestWireRejections:
+    def frame(self):
+        return encode_product_wire(HDR, np.ones((2, 3), np.float32))
+
+    def test_bad_magic(self):
+        buf = b"XXXX" + self.frame()[4:]
+        with pytest.raises(WireError):
+            decode_product_wire(buf)
+
+    def test_truncated_prefix(self):
+        with pytest.raises(WireError):
+            decode_product_wire(self.frame()[:6])
+
+    def test_truncated_payload(self):
+        with pytest.raises(WireError):
+            decode_product_wire(self.frame()[:-4])
+
+    def test_trailing_garbage_rejected(self):
+        with pytest.raises(WireError):
+            decode_product_wire(self.frame() + b"\x00")
+
+    def test_oversized_meta(self):
+        buf = (WIRE_MAGIC
+               + (WIRE_MAX_META + 1).to_bytes(4, "big") + b"{}")
+        with pytest.raises(WireError):
+            decode_product_wire(buf)
+
+    def test_bad_deflate_body(self):
+        with pytest.raises(WireError):
+            decode_product_wire(b"not deflate at all",
+                                encoding="deflate")
+
+    def test_negotiation_predicate(self):
+        assert wants_binary_product(
+            "application/x-blit-product, application/json")
+        assert not wants_binary_product("application/json")
+        assert not wants_binary_product(None)
+
+
+class TestJsonBinaryCrossCompat:
+    def test_both_wires_decode_identically(self):
+        # The acceptance pin: a binary-wire response must be
+        # byte-identical (values, dtype, shape, header) to what the
+        # legacy JSON+base64 wire delivers for the same product.
+        data = (np.arange(60).reshape(3, 4, 5) * 0.11).astype(
+            np.float32)
+        hj, dj = decode_product(encode_product(HDR, data))
+        hb, db = decode_product_wire(encode_product_wire(HDR, data))
+        assert hj == hb
+        assert dj.dtype == db.dtype
+        assert dj.shape == db.shape
+        assert dj.tobytes() == db.tobytes()
+
+
+class TestWireCacheTier:
+    def make(self, tmp_path, ram_bytes=1 << 20):
+        return ProductCache(str(tmp_path / "c"), ram_bytes=ram_bytes,
+                            timeline=Timeline())
+
+    def body(self, seed=0, n=64):
+        return encode_product_wire(
+            HDR, np.full(n, seed, dtype=np.float32))
+
+    def test_ram_hit_and_counters(self, tmp_path):
+        c = self.make(tmp_path)
+        c.put_wire("fp1", self.body(1))
+        body, tier = c.get_wire("fp1")
+        assert tier == "ram"
+        assert body == self.body(1)
+        s = c.stats()
+        assert s["hit.wire"] == 1
+        assert s["hit.ram"] >= 1
+
+    def test_miss_returns_none_uncounted(self, tmp_path):
+        c = self.make(tmp_path)
+        assert c.get_wire("nope") is None
+        assert c.stats().get("miss", 0) == 0  # caller's get() counts
+
+    def test_disk_spill_and_promotion(self, tmp_path):
+        c = self.make(tmp_path)
+        c.put_wire("fp1", self.body(1))
+        with c._lock:  # drop the RAM copy, keep the .wire file
+            c._wire.pop("fp1")
+            c._wire_used = 0
+        body, tier = c.get_wire("fp1")
+        assert tier == "disk"
+        assert body == self.body(1)
+        # Promoted: the next hit is RAM.
+        assert c.get_wire("fp1")[1] == "ram"
+
+    def test_corrupt_wire_file_evicted_not_served(self, tmp_path):
+        c = self.make(tmp_path)
+        c.put_wire("fp1", self.body(1))
+        with c._lock:
+            c._wire.pop("fp1")
+            c._wire_used = 0
+        p = c.wire_path("fp1")
+        blob = bytearray(open(p, "rb").read())
+        blob[len(blob) // 2] ^= 0xFF  # flip a payload bit
+        open(p, "wb").write(bytes(blob))
+        assert c.get_wire("fp1") is None
+        assert c.stats()["evict.corrupt"] >= 1
+        import os
+
+        assert not os.path.exists(p)
+
+    def test_crc_footer_is_crc32(self, tmp_path):
+        c = self.make(tmp_path)
+        c.put_wire("fp1", self.body(1))
+        blob = open(c.wire_path("fp1"), "rb").read()
+        body, crc = blob[:-4], int.from_bytes(blob[-4:], "big")
+        assert body == self.body(1)
+        assert crc == (zlib.crc32(body) & 0xFFFFFFFF)
+
+    def test_wire_never_displaces_products(self, tmp_path):
+        # The wire tier shares the RAM budget but is always the first
+        # evicted and never pushes a product out.
+        c = self.make(tmp_path, ram_bytes=4096)
+        arr = np.zeros(512, dtype=np.float32)  # 2048 B
+        c.put("prod1", dict(HDR), arr)
+        big = b"x" * 3000  # cannot fit beside the product
+        c.put_wire("fpw", big)
+        assert c.get("prod1") is not None  # product survived
+        s = c.stats()
+        assert s["ram_entries"] == 1
+        assert s["wire_bytes_used"] + s["ram_bytes_used"] <= 4096
+
+    def test_clear_drops_wire_tier(self, tmp_path):
+        c = self.make(tmp_path)
+        c.put_wire("fp1", self.body(1))
+        c.clear()
+        assert c.stats()["wire_entries"] == 0
+        import os
+
+        assert not os.path.exists(c.wire_path("fp1"))
