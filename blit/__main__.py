@@ -538,9 +538,14 @@ def _cmd_fleet_peer(args: argparse.Namespace) -> int:
         with open(tmp, "w") as f:
             f.write(str(server.port))
         os.replace(tmp, args.port_file)
+    # --standby (ISSUE 17): the peer itself serves identically — it is
+    # the FRONT DOOR that keeps a standby out of the ring until the
+    # elastic controller admits it.  The flag rides the bring-up line
+    # so spawners and operators see the role the process was given.
     print(json.dumps({"name": args.name, "url": server.url,
                       "pid": os.getpid(), "lease_dir": args.lease_dir,
-                      "proc": args.proc}), flush=True)
+                      "proc": args.proc,
+                      "standby": bool(args.standby)}), flush=True)
     try:
         stop.wait()
     except KeyboardInterrupt:
@@ -555,13 +560,19 @@ def _spawn_fleet_peers(td: str, npeers: int, *, concurrency: int,
                        queue_depth: int, ram_bytes: int,
                        beat_interval_s: float = 0.2,
                        bringup_timeout_s: float = 120.0,
+                       standbys: int = 0,
                        extra_env: Optional[dict] = None):
     """Bring up ``npeers`` REAL ``blit fleet-peer`` subprocesses (the
     bench/chaos rig): per-peer cache dirs + one shared lease dir under
     ``td``, ephemeral ports published through port files.  Returns
     ``(procs, peers, lease_dir)`` with ``procs`` a list of
     ``(Popen, logfile)`` pairs and ``peers`` the name→url map the
-    front door takes."""
+    front door takes.
+
+    ``standbys`` additionally spawns that many ``--standby`` peers
+    (ISSUE 17): named ``standby{j}``, lease proc ``npeers + j``,
+    appended to both ``procs`` and ``peers`` — the caller registers
+    them via ``door.add_standby`` instead of the ring-seeding map."""
     import os
     import subprocess
     import time as _time
@@ -569,12 +580,14 @@ def _spawn_fleet_peers(td: str, npeers: int, *, concurrency: int,
     from blit.serve.http import wait_http_ready
 
     lease_dir = os.path.join(td, "leases")
+    names = [f"peer{i}" for i in range(npeers)]
+    names += [f"standby{j}" for j in range(max(0, standbys))]
     procs, peers = [], {}
-    for i in range(npeers):
-        port_file = os.path.join(td, f"peer{i}.port")
+    for i, name in enumerate(names):
+        port_file = os.path.join(td, f"{name}.port")
         cmd = [sys.executable, "-m", "blit", "fleet-peer",
-               "--name", f"peer{i}",
-               "--cache-dir", os.path.join(td, f"cache{i}"),
+               "--name", name,
+               "--cache-dir", os.path.join(td, f"cache-{name}"),
                "--lease-dir", lease_dir, "--proc", str(i),
                "--port", "0", "--port-file", port_file,
                "--concurrency", str(concurrency),
@@ -582,28 +595,30 @@ def _spawn_fleet_peers(td: str, npeers: int, *, concurrency: int,
                "--ram-bytes", str(ram_bytes),
                "--beat-interval", str(beat_interval_s),
                "--retry-seed", str(i)]
+        if i >= npeers:
+            cmd.append("--standby")
         env = dict(os.environ)
         env.setdefault("JAX_PLATFORMS", "cpu")
         env.update(extra_env or {})
-        logf = open(os.path.join(td, f"peer{i}.log"), "w")
+        logf = open(os.path.join(td, f"{name}.log"), "w")
         procs.append((subprocess.Popen(cmd, stdout=logf, stderr=logf,
                                        env=env), logf))
     try:
-        for i in range(npeers):
-            port_file = os.path.join(td, f"peer{i}.port")
+        for i, name in enumerate(names):
+            port_file = os.path.join(td, f"{name}.port")
             deadline = _time.monotonic() + bringup_timeout_s
             while not os.path.exists(port_file):
                 if procs[i][0].poll() is not None:
                     raise RuntimeError(
-                        f"peer{i} died at bring-up "
-                        f"(rc={procs[i][0].returncode}; see peer{i}.log)")
+                        f"{name} died at bring-up "
+                        f"(rc={procs[i][0].returncode}; see {name}.log)")
                 if _time.monotonic() > deadline:
-                    raise TimeoutError(f"peer{i} port file never appeared")
+                    raise TimeoutError(f"{name} port file never appeared")
                 _time.sleep(0.05)
             with open(port_file) as f:
                 url = f"http://127.0.0.1:{int(f.read().strip())}"
             wait_http_ready(url, timeout_s=bringup_timeout_s)
-            peers[f"peer{i}"] = url
+            peers[name] = url
     except BaseException:
         _reap_fleet_peers(procs)
         raise
@@ -660,6 +675,8 @@ def _cmd_serve_bench(args: argparse.Namespace) -> int:
 
     if args.archive_day:
         return _serve_bench_archive_day(args)
+    if args.diurnal:
+        return _serve_bench_diurnal(args)
     if args.fleet:
         return _serve_bench_fleet(args)
     from blit.config import DEFAULT
@@ -1094,6 +1111,342 @@ def _serve_bench_fleet(args: argparse.Namespace) -> int:
             door.close()
             _reap_fleet_peers(procs)
     return 1 if errors else 0
+
+
+def _serve_bench_diurnal(args: argparse.Namespace) -> int:
+    """``serve-bench --diurnal`` (ISSUE 17 tentpole #4): day-shaped
+    load at accelerated clock over a REAL fleet with the ELASTIC
+    controller in the loop.  Each cycle is one diurnal swing: a peak
+    burst that should page the burn-rate evaluator into a scale-out
+    (warm handoff → membership flip; forced through the manual lever
+    when the rig serves the peak inside the SLO, and the report says
+    which lever moved), a post-resize probe that pins the hit-rate
+    within 10% of the pre-resize probe, then a trough of idle
+    controller ticks that drains the coldest peer back out.  The
+    report asserts what the acceptance gates on: SLO attainment
+    through all the resizes, the hit-rate bound per cycle, and ZERO
+    requests routed to a departed peer."""
+    import math
+    import os
+    import random
+    import tempfile
+    import threading
+    import time as _time
+
+    from blit.monitor import BurnRateEvaluator, SLObjective
+    from blit.observability import HistogramStats, Timeline
+    from blit.serve import Overloaded, ProductRequest
+    from blit.serve.elastic import FleetController
+    from blit.serve.fleet import FleetError, FleetFrontDoor
+    from blit.serve.http import http_json, install_drain_handler
+    from blit.serve.scheduler import DeadlineExpired
+    from blit.testing import synth_raw
+
+    rng = random.Random(args.seed)
+    tl = Timeline()
+    cycles = max(1, args.cycles)
+    standbys = args.standbys if args.standbys is not None else cycles
+    report: dict = {"diurnal": True, "cycles": cycles,
+                    "peers": args.peers, "standbys": standbys,
+                    "replicas": args.replicas, "distinct": args.distinct,
+                    "clients": args.clients, "zipf_s": args.zipf_s}
+    ok = False
+    with tempfile.TemporaryDirectory(prefix="blit-diurnal-") as td:
+        ntime = (8 + 3) * args.nfft  # 8 PFB frames at ntap=4
+        reqs = []
+        for i in range(args.distinct):
+            path = os.path.join(td, f"bench{i:03d}.raw")
+            synth_raw(path, nblocks=1, obsnchan=2, ntime_per_block=ntime,
+                      seed=i)
+            reqs.append(ProductRequest(raw=path, nfft=args.nfft, nint=1))
+        procs, peers, lease_dir = _spawn_fleet_peers(
+            td, args.peers, concurrency=args.concurrency,
+            queue_depth=args.queue_depth, ram_bytes=args.ram_bytes,
+            standbys=standbys)
+        names = [f"peer{i}" for i in range(args.peers)]
+        standby_names = [f"standby{j}" for j in range(standbys)]
+        proc_of = {nm: procs[i][0]
+                   for i, nm in enumerate(names + standby_names)}
+        door = FleetFrontDoor(
+            {nm: peers[nm] for nm in names}, lease_dir=lease_dir,
+            timeline=tl, replicas=args.replicas,
+            peer_ttl_s=args.peer_ttl, poll_s=min(0.1, args.peer_ttl / 4),
+            hedge_floor_s=args.hedge_floor_ms / 1e3,
+            request_timeout_s=60.0).start()
+        for j, nm in enumerate(standby_names):
+            door.add_standby(nm, peers[nm], proc=args.peers + j)
+
+        def terminate(nm: str) -> None:
+            """The scale-in epilogue: SIGTERM the retired child — the
+            peer's drain handler finishes in-flight work and exits."""
+            p = proc_of.get(nm)
+            if p is not None and p.poll() is None:
+                p.terminate()
+
+        uninstall = install_drain_handler(lambda: door.drain())
+        weights = [1.0 / math.pow(k + 1, args.zipf_s)
+                   for k in range(args.distinct)]
+        slo_s = args.slo_ms / 1e3
+        lat = HistogramStats()
+        lock = threading.Lock()
+        counts = {"issued": 0, "served": 0, "attained": 0,
+                  "rejected": 0, "expired": 0}
+        errors: list = []
+
+        def run_burst(n: int, record: bool = True) -> None:
+            picks = rng.choices(range(args.distinct), weights=weights,
+                                k=n)
+            it = iter(picks)
+
+            def worker(cid: int) -> None:
+                while True:
+                    with lock:
+                        k = next(it, None)
+                    if k is None:
+                        return
+                    t = _time.perf_counter()
+                    got, err = False, None
+                    for _attempt in range(4):
+                        try:
+                            door.get(reqs[k], client=f"diurnal{cid}")
+                            got = True
+                            break
+                        except DeadlineExpired:
+                            with lock:
+                                counts["expired"] += 1
+                            break
+                        except Overloaded as e:
+                            with lock:
+                                counts["rejected"] += 1
+                            _time.sleep(min(0.25, e.retry_after_s))
+                        except (FleetError, OSError) as e:
+                            # Transient while a flip/eject settles:
+                            # back off a beat and retry, like a real
+                            # client's loop.
+                            err = repr(e)
+                            _time.sleep(0.2)
+                        except Exception as e:  # noqa: BLE001
+                            err = repr(e)
+                            break
+                    if not got and err is not None:
+                        with lock:
+                            errors.append(err)
+                    if not record:
+                        continue
+                    dt = _time.perf_counter() - t
+                    lat.observe(dt)
+                    with lock:
+                        counts["issued"] += 1
+                        if got:
+                            counts["served"] += 1
+                            if dt <= slo_s:
+                                counts["attained"] += 1
+
+            threads = [threading.Thread(target=worker, args=(c,))
+                       for c in range(args.clients)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+
+        def cache_totals() -> dict:
+            out = {}
+            for nm, p in sorted(door._peers.items()):
+                try:
+                    _, _, s = http_json("GET", p.url, "/stats",
+                                        timeout=2.0, pool=door.pool)
+                except OSError:
+                    continue
+                c = s.get("cache") or {}
+                out[nm] = (c.get("hit.ram", 0) + c.get("hit.disk", 0),
+                           c.get("miss", 0))
+            return out
+
+        def window_hit_rate(before: dict, after: dict):
+            dh = dm = 0
+            for nm, (h1, m1) in after.items():
+                if nm not in before:
+                    continue
+                h0, m0 = before[nm]
+                dh += max(0, h1 - h0)
+                dm += max(0, m1 - m0)
+            return (dh / (dh + dm)) if dh + dm else None
+
+        peak_n = max(16, args.requests // 2)
+        probe_n = max(12, args.requests // 4)
+        tick_s = 30.0  # the accelerated clock: one tick "is" 30s of day
+        forced = {"out": 0, "in": 0}
+        departed: dict = {}
+        cyc_reports: list = []
+        ctl = None
+        try:
+            # Untimed warm-up: first-touch XLA compiles and cache fills
+            # land OUTSIDE the SLO ledger, like a deployment bring-up.
+            run_burst(args.requests, record=False)
+            ev = BurnRateEvaluator(
+                [SLObjective("fleet-latency", "fleet.request_s",
+                             args.burn_threshold_ms / 1e3, budget=0.05)],
+                fast_window=2, slow_window=4, fast_burn=4.0,
+                slow_burn=2.0)
+            ctl = FleetController(
+                door, ev, feed=tl, terminate=terminate,
+                idle_windows=args.idle_windows,
+                hysteresis_s=args.hysteresis,
+                warm_timeout_s=args.warm_timeout,
+                min_peers=args.peers, poll_s=0.5)
+            # Prime the feed baseline so the warm-up's latencies are
+            # not the first tick's delta — the day starts NOW.
+            ctl._feed_state = tl.state()
+            t0 = _time.perf_counter()
+            for c in range(cycles):
+                ring_pre = sorted(door.ring.peers())
+                # Pre-resize probe: the hit-rate the flip must not
+                # crater (caches are warm from the previous swing).
+                a0 = cache_totals()
+                run_burst(probe_n)
+                a1 = cache_totals()
+                hit_pre = window_hit_rate(a0, a1)
+                # -- PEAK: the day's load pages the evaluator.
+                out_rec = None
+                for _ in range(4):
+                    run_burst(peak_n)
+                    act = ctl.observe(interval_s=tick_s)
+                    if act is not None and act["action"] == "scale-out":
+                        out_rec = act
+                        break
+                organic_out = out_rec is not None
+                if out_rec is None:
+                    # A fast rig can serve the whole peak inside the
+                    # SLO; force the flip so the resize contract is
+                    # still exercised — the report says which lever.
+                    out_rec = ctl.scale_out()
+                    if out_rec is not None:
+                        forced["out"] += 1
+                # Post-resize probe: the warm-handoff dividend.
+                b0 = cache_totals()
+                run_burst(probe_n)
+                b1 = cache_totals()
+                hit_post = window_hit_rate(b0, b1)
+                hit_ok = (hit_pre is not None and hit_post is not None
+                          and hit_post >= hit_pre - 0.10)
+                # -- TROUGH: sustained idle drains the coldest peer.
+                _time.sleep(args.hysteresis)  # let the flap guard lapse
+                in_rec = None
+                for _ in range(args.idle_windows + 6):
+                    act = ctl.observe(interval_s=tick_s)
+                    if act is not None and act["action"] == "scale-in":
+                        in_rec = act
+                        break
+                organic_in = in_rec is not None
+                if in_rec is None:
+                    in_rec = ctl.scale_in()
+                    if in_rec is not None:
+                        forced["in"] += 1
+                if in_rec is not None:
+                    victim = in_rec["peer"]
+                    departed[victim] = door._peers[victim].requests
+                _time.sleep(args.hysteresis)  # disarm before next peak
+                cyc_reports.append({
+                    "cycle": c,
+                    "ring_pre": ring_pre,
+                    "ring_post": sorted(door.ring.peers()),
+                    "scale_out": out_rec,
+                    "organic_out": organic_out,
+                    "scale_in": in_rec,
+                    "organic_in": organic_in,
+                    "hit_rate_pre_resize": (round(hit_pre, 4)
+                                            if hit_pre is not None
+                                            else None),
+                    "hit_rate_post_resize": (round(hit_post, 4)
+                                             if hit_post is not None
+                                             else None),
+                    "hit_bound_ok": hit_ok,
+                })
+            wall = _time.perf_counter() - t0
+            # ZERO requests to a departed peer: the per-peer request
+            # counter of every retired peer must not have moved since
+            # its retirement.
+            requests_to_departed = sum(
+                max(0, door._peers[nm].requests - snap)
+                for nm, snap in departed.items())
+            attain = (counts["attained"] / counts["issued"]
+                      if counts["issued"] else None)
+            slo_ok = attain is not None and attain >= args.slo_floor
+            resizes_out = sum(1 for r in cyc_reports if r["scale_out"])
+            resizes_in = sum(1 for r in cyc_reports if r["scale_in"])
+            hit_ok_all = all(r["hit_bound_ok"] for r in cyc_reports)
+            fstats = door.stats()
+            cnt = fstats["counters"]
+            rh = tl.hists.get("elastic.resize_s")
+            wb = tl.hists.get("elastic.warm_bytes")
+            ok = (resizes_out >= cycles and resizes_in >= cycles
+                  and slo_ok and hit_ok_all
+                  and requests_to_departed == 0 and not errors)
+            report.update(
+                requests=counts["issued"],
+                served=counts["served"],
+                wall_s=round(wall, 3),
+                slo={"target_s": slo_s,
+                     "attained": (round(attain, 4)
+                                  if attain is not None else None),
+                     "floor": args.slo_floor, "ok": slo_ok},
+                request_p50_s=round(lat.percentile(0.50), 6),
+                request_p99_s=round(lat.percentile(0.99), 6),
+                scale_outs=resizes_out,
+                scale_ins=resizes_in,
+                forced_resizes=forced,
+                requests_to_departed=requests_to_departed,
+                hit_bound_ok=hit_ok_all,
+                cycles_detail=cyc_reports,
+                elastic={
+                    "scale_out": cnt.get("elastic.scale_out", 0),
+                    "scale_in": cnt.get("elastic.scale_in", 0),
+                    "warm_timeout": cnt.get("elastic.warm_timeout", 0),
+                    "flap_suppressed": cnt.get(
+                        "elastic.flap_suppressed", 0),
+                    "resize_p50_s": (round(rh.percentile(0.50), 6)
+                                     if rh is not None else None),
+                    "resize_p99_s": (round(rh.percentile(0.99), 6)
+                                     if rh is not None else None),
+                    "warm_bytes": int(wb.total) if wb is not None else 0,
+                },
+                controller=ctl.stats(),
+                rejected_overloaded=counts["rejected"],
+                deadline_expired=counts["expired"],
+                errors=errors[:5],
+            )
+            # The flat scalar block bench-diff extracts and gates,
+            # exactly like the ingest/archive-day records.
+            report["metrics"] = {
+                "diurnal.cycles": float(len(cyc_reports)),
+                "diurnal.slo_attained": float(attain or 0.0),
+                "diurnal.request_p50_s": report["request_p50_s"],
+                "diurnal.request_p99_s": report["request_p99_s"],
+                "diurnal.scale_out": float(cnt.get(
+                    "elastic.scale_out", 0)),
+                "diurnal.scale_in": float(cnt.get("elastic.scale_in", 0)),
+                "diurnal.warm_timeouts": float(cnt.get(
+                    "elastic.warm_timeout", 0)),
+                "diurnal.requests_to_departed": float(
+                    requests_to_departed),
+                "diurnal.post_resize_min_hit_rate": float(min(
+                    (r["hit_rate_post_resize"] for r in cyc_reports
+                     if r["hit_rate_post_resize"] is not None),
+                    default=0.0)),
+            }
+            report["ok"] = ok
+            body = json.dumps(report)
+            print(body)
+            if args.out:
+                with open(args.out, "w") as f:
+                    f.write(body)
+        finally:
+            uninstall()
+            if ctl is not None:
+                ctl.close()
+            door.close()
+            _reap_fleet_peers(procs)
+    return 0 if ok else 1
 
 
 def _serve_bench_archive_day(args: argparse.Namespace) -> int:
@@ -2114,6 +2467,242 @@ def _chaos_fleet(args: argparse.Namespace, work: str, report: dict) -> int:
     return 0 if ok else 1
 
 
+def _chaos_fleet_resize(args: argparse.Namespace, work: str,
+                        report: dict) -> int:
+    """``blit chaos --fleet --fault resize`` (ISSUE 17): SIGKILL a
+    serving peer DURING the elastic warm handoff — the worst moment:
+    the controller is mid-flip, the joiner is computing its incoming
+    hot range, and a peer that was supposed to keep serving dies.
+    Asserts the resize contract under fire:
+
+    - the membership flip still COMPLETES (the standby is admitted;
+      fail-open if the handoff deadline burns),
+    - ``/healthz`` answers an honest ``"resizing"`` mid-flip,
+    - the killed peer is detected within the lease TTL and ejected,
+    - every request completes BYTE-IDENTICAL to a single-process
+      oracle,
+    - post-resize hit-rate is within 10% of pre-resize.
+
+    The product set is EXTENDED until the joiner's incoming key range
+    holds several hot products, so the handoff has real work to
+    interrupt (otherwise the flip is sub-millisecond and the kill
+    cannot land inside it)."""
+    import math
+    import os
+    import random
+    import signal
+    import threading
+    import time as _time
+
+    import numpy as np
+
+    from blit.observability import Timeline
+    from blit.serve import Overloaded, ProductRequest
+    from blit.serve.cache import fingerprint_for
+    from blit.serve.elastic import FleetController
+    from blit.serve.fleet import FleetError, FleetFrontDoor
+    from blit.serve.http import http_json
+    from blit.serve.scheduler import DeadlineExpired
+    from blit.testing import synth_raw
+
+    rng = random.Random(args.seed)
+    nfft = args.nfft
+    joiner = "standby0"
+    total = max(30, args.fleet_requests)
+    ntime = (8 + 3) * nfft
+    reqs, oracle, fps = [], {}, []
+
+    def add_product(i: int) -> None:
+        path = os.path.join(work, f"prod{i:02d}.raw")
+        synth_raw(path, nblocks=1, obsnchan=2, ntime_per_block=ntime,
+                  seed=args.seed + i)
+        req = ProductRequest(raw=path, nfft=nfft, nint=1)
+        reqs.append(req)
+        fps.append(fingerprint_for(req.reducer(), req.raw_source))
+        # The single-process oracle: the same reduction, no fleet.
+        _, data = req.reducer().reduce(path)
+        oracle[i] = np.asarray(data)
+
+    for i in range(max(2, args.fleet_distinct)):
+        add_product(i)
+    procs, peers, lease_dir = _spawn_fleet_peers(
+        work, args.peers, concurrency=2, queue_depth=32,
+        ram_bytes=64 << 20,
+        beat_interval_s=min(0.2, args.lease_ttl / 5), standbys=1)
+    tl = Timeline()
+    door = FleetFrontDoor(
+        {f"peer{i}": peers[f"peer{i}"] for i in range(args.peers)},
+        lease_dir=lease_dir, timeline=tl, replicas=args.replicas,
+        peer_ttl_s=args.lease_ttl, poll_s=args.poll,
+        health_poll_s=max(args.poll, 0.5),
+        hedge_floor_s=0.05, request_timeout_s=10.0).start()
+    door.add_standby(joiner, peers[joiner], proc=args.peers)
+    ctl = FleetController(door, None, hysteresis_s=0.0,
+                          warm_timeout_s=30.0, min_peers=1,
+                          warm_hints=64, timeline=tl)
+    # Grow the mix until >= 3 products will MOVE to the joiner on
+    # admit — the handoff then computes them on the cold joiner, a
+    # window wide enough to kill a peer inside.
+    while len(reqs) < 40 and \
+            len(door.ring.incoming_keys(joiner, fps)) < 3:
+        add_product(len(reqs))
+    incoming = door.ring.incoming_keys(joiner, fps)
+
+    victim = door.ring.owners(fps[0])[0]
+    victim_proc = procs[int(victim.removeprefix("peer"))][0]
+    weights = [1.0 / math.pow(k + 1, 1.2) for k in range(len(reqs))]
+    picks = rng.choices(range(len(reqs)), weights=weights, k=total)
+    third = total // 3
+
+    def cache_totals() -> dict:
+        out = {}
+        for name, url in peers.items():
+            try:
+                _, _, s = http_json("GET", url, "/stats", timeout=2.0)
+            except OSError:
+                continue
+            c = s.get("cache") or {}
+            out[name] = (c.get("hit.ram", 0) + c.get("hit.disk", 0),
+                         c.get("miss", 0))
+        return out
+
+    def window_hit_rate(before: dict, after: dict):
+        dh = dm = 0
+        for name, (h1, m1) in after.items():
+            if name not in before:
+                continue
+            h0, m0 = before[name]
+            dh += max(0, h1 - h0)
+            dm += max(0, m1 - m0)
+        return (dh / (dh + dm)) if dh + dm else None
+
+    failed: list = []
+    diffs: list = []
+
+    def run_slice(idxs) -> None:
+        for k in idxs:
+            for _attempt in range(8):
+                try:
+                    _, d = door.get(reqs[k], client="chaos")
+                except Overloaded as e:
+                    _time.sleep(min(0.25, e.retry_after_s))
+                    continue
+                except (FleetError, DeadlineExpired, OSError):
+                    _time.sleep(0.2)
+                    continue
+                if not np.array_equal(np.asarray(d), oracle[k]):
+                    diffs.append(k)
+                failed_here = False
+                break
+            else:
+                failed_here = True
+            if failed_here:
+                failed.append(k)
+
+    flip_completed = detected = False
+    mid_handoff = False
+    resizing_status = None
+    detect_s = None
+    hit_pre = hit_post = None
+    out_rec: list = []
+    try:
+        # Warm every product once (so the door's hot map knows the
+        # whole range), then the zipfian pre window.
+        run_slice(list(range(len(reqs))) + picks[:third])
+        marks = {"warm": cache_totals()}
+        run_slice(picks[third:2 * third])
+        marks["pre"] = cache_totals()
+        hit_pre = window_hit_rate(marks["warm"], marks["pre"])
+        health_pre = door.health()
+
+        # The flip, in a thread — and the kill, INSIDE the handoff.
+        t = threading.Thread(target=lambda: out_rec.append(
+            ctl.scale_out(joiner)))
+        t.start()
+        gate = _time.monotonic() + 30.0
+        while _time.monotonic() < gate:
+            if door.resize_reason is not None:
+                mid_handoff = True
+                break
+            _time.sleep(0.001)
+        if mid_handoff:
+            resizing_status = door.health()["status"]
+        t_kill = _time.monotonic()
+        victim_proc.send_signal(signal.SIGKILL)
+        t.join(timeout=120.0)
+        flip_completed = joiner in door.ring
+
+        detect_budget = args.lease_ttl * 3 + 5.0
+        while victim in door.ring and \
+                _time.monotonic() - t_kill < detect_budget:
+            _time.sleep(args.poll / 2)
+        detect_s = _time.monotonic() - t_kill
+        detected = victim not in door.ring
+
+        tail = picks[2 * third:]
+        run_slice(tail[:len(tail) // 2])             # recovery window
+        marks["recovering"] = cache_totals()
+        run_slice(tail[len(tail) // 2:])             # recovered window
+        marks["recovered"] = cache_totals()
+        hit_post = window_hit_rate(marks["recovering"],
+                                   marks["recovered"])
+        health_final = door.health()
+
+        fstats = door.stats()
+        hit_recovered = (hit_pre is not None and hit_post is not None
+                         and hit_post >= hit_pre - 0.10)
+        report.update(
+            peers=args.peers,
+            replicas=args.replicas,
+            requests=total,
+            distinct=len(reqs),
+            joiner=joiner,
+            joiner_incoming=len(incoming),
+            victim=victim,
+            killed_mid_handoff=mid_handoff,
+            resizing_status=resizing_status,
+            flip_completed=flip_completed,
+            warm=(out_rec[0] if out_rec else None),
+            detected=detected,
+            detect_s=round(detect_s, 3),
+            lease_ttl_s=args.lease_ttl,
+            recovered=detected and not failed,
+            byte_identical=not diffs,
+            differing_products=diffs[:8],
+            failed_requests=len(failed),
+            hit_rate_pre_resize=(round(hit_pre, 4)
+                                 if hit_pre is not None else None),
+            hit_rate_post_resize=(round(hit_post, 4)
+                                  if hit_post is not None else None),
+            hit_rate_recovered=hit_recovered,
+            healthz={
+                "pre": health_pre["status"],
+                "mid_flip": resizing_status,
+                "final": health_final["status"],
+                "final_reasons": health_final["reasons"],
+            },
+            counters=fstats["counters"],
+            work_dir=work,
+        )
+    finally:
+        ctl.close()
+        door.close()
+        _reap_fleet_peers(procs)
+
+    ok = (flip_completed and mid_handoff
+          and resizing_status == "resizing"
+          and report.get("recovered", False)
+          and report.get("byte_identical", False)
+          and report.get("hit_rate_recovered", False))
+    report["ok"] = ok
+    body = json.dumps(report)
+    print(body)
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            f.write(body)
+    return 0 if ok else 1
+
+
 def _cmd_chaos(args: argparse.Namespace) -> int:
     """``blit chaos`` (ISSUE 12): run a SEEDED kill/hang schedule
     against a real supervised workload — a multi-process sharded scan
@@ -2140,10 +2729,16 @@ def _cmd_chaos(args: argparse.Namespace) -> int:
                   "not corrupt", file=sys.stderr)
             return 2
         report = {"workload": "fleet", "fault": args.fault}
+        if args.fault == "resize":
+            return _chaos_fleet_resize(args, work, report)
         return _chaos_fleet(args, work, report)
     if args.fault == "partition":
         print("--fault partition requires --fleet (a network partition "
               "is a serving-fleet failure shape)", file=sys.stderr)
+        return 2
+    if args.fault == "resize":
+        print("--fault resize requires --fleet (an elastic membership "
+              "flip is a serving-fleet failure shape)", file=sys.stderr)
         return 2
     point = args.point or ("stream.chunk" if args.workload == "stream"
                            else "mesh.window")
@@ -2939,7 +3534,37 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "binary pass (--archive-day)")
     pb.add_argument("--out", default=None, metavar="PATH",
                     help="also write the report JSON here "
-                         "(--archive-day; the CI artifact)")
+                         "(--archive-day / --diurnal; the CI artifact)")
+    pb.add_argument("--diurnal", action="store_true",
+                    help="day-shaped load at accelerated clock over a "
+                         "REAL fleet + standbys with the ELASTIC "
+                         "controller in the loop (ISSUE 17): peak "
+                         "pages scale-out through a warm handoff, "
+                         "trough idles into a drain + scale-in; the "
+                         "report pins SLO attainment through the "
+                         "resizes and the post-resize hit-rate bound")
+    pb.add_argument("--cycles", type=int, default=3,
+                    help="peak/trough cycles, i.e. scale-out/in pairs "
+                         "(--diurnal)")
+    pb.add_argument("--standbys", type=int, default=None,
+                    help="standby fleet-peer subprocesses to pre-"
+                         "register (--diurnal; default --cycles)")
+    pb.add_argument("--idle-windows", type=int, default=3,
+                    help="consecutive idle controller ticks before "
+                         "scale-in (--diurnal)")
+    pb.add_argument("--hysteresis", type=float, default=2.0,
+                    help="flap-guard cooldown seconds after any resize "
+                         "(--diurnal)")
+    pb.add_argument("--warm-timeout", type=float, default=60.0,
+                    help="warm-handoff ack deadline seconds — the "
+                         "joiner's first XLA compile happens inside it "
+                         "(--diurnal)")
+    pb.add_argument("--burn-threshold-ms", type=float, default=250.0,
+                    help="per-request latency SLO the burn-rate "
+                         "evaluator pages on (--diurnal)")
+    pb.add_argument("--slo-floor", type=float, default=0.5,
+                    help="minimum end-to-end SLO attainment the "
+                         "diurnal leg must hold through the resizes")
     pb.set_defaults(fn=_cmd_serve_bench)
 
     pfp = sub.add_parser(
@@ -2974,6 +3599,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                      help="lease heartbeat cadence (keep well under "
                           "the fleet's peer TTL)")
     pfp.add_argument("--drain-timeout", type=float, default=30.0)
+    pfp.add_argument("--standby", action="store_true",
+                     help="run as an elastic STANDBY (ISSUE 17): "
+                          "process up and lease beating but NOT in the "
+                          "ring — the front door's controller admits "
+                          "it after a warm handoff when the SLO pages")
     pfp.set_defaults(fn=_cmd_fleet_peer)
 
     pc = sub.add_parser(
@@ -2987,13 +3617,18 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="what to break: a supervised sharded scan, a "
                          "supervised sharded search, or a live consumer")
     pc.add_argument("--fault", default="kill",
-                    choices=["kill", "hang", "corrupt", "partition"],
+                    choices=["kill", "hang", "corrupt", "partition",
+                             "resize"],
                     help="the injected failure mode (corrupt = the "
                          "ISSUE 13 integrity leg: a bit-flipped "
                          "delivered RAW frame under a digest sidecar "
                          "must be masked, not propagated; partition = "
                          "--fleet only: SIGSTOP then SIGCONT, the peer "
-                         "must be ejected AND rejoin)")
+                         "must be ejected AND rejoin; resize = --fleet "
+                         "only: SIGKILL a serving peer DURING the "
+                         "elastic warm handoff, the flip must still "
+                         "complete with byte-identical answers, "
+                         "ISSUE 17)")
     pc.add_argument("--fleet", action="store_true",
                     help="break a SERVING fleet instead (ISSUE 14): "
                          "SIGKILL/SIGSTOP a real fleet-peer subprocess "

@@ -256,6 +256,26 @@ class SiteConfig:
     fleet_wire: str = "binary"
     fleet_pool_conns: int = 4
     fleet_wire_deflate: bool = False
+    # Elastic fleet plane (blit/serve/elastic.py; ISSUE 17).  The
+    # FleetController scales OUT (admits a lease-fresh standby after a
+    # warm handoff bounded by elastic_warm_timeout_s, streaming up to
+    # elastic_warm_hints hot recipes from the joiner's incoming key
+    # range) when the burn-rate evaluator pages, and scales IN (drains
+    # the coldest peer, bounded by elastic_drain_timeout_s, never below
+    # elastic_min_peers) after elastic_idle_windows consecutive
+    # observation ticks under elastic_idle_rps requests/s.  Any resize
+    # arms a flap guard: no further action for elastic_hysteresis_s, so
+    # a page→idle→page cycle cannot thrash membership.
+    # elastic_poll_s is the controller's observation cadence.
+    # Per-process overrides: BLIT_ELASTIC_* (:func:`elastic_defaults`).
+    elastic_idle_rps: float = 0.1
+    elastic_idle_windows: int = 6
+    elastic_hysteresis_s: float = 60.0
+    elastic_warm_timeout_s: float = 30.0
+    elastic_warm_hints: int = 32
+    elastic_min_peers: int = 1
+    elastic_poll_s: float = 1.0
+    elastic_drain_timeout_s: float = 30.0
     # Fleet request observability (blit/observability.py RequestLog +
     # histogram exemplars; ISSUE 15).  request_log_dir, when set, makes
     # every serving component (ProductService, fleet front door, peer
@@ -541,6 +561,33 @@ def fleet_defaults(config: SiteConfig = DEFAULT) -> Dict:
             "BLIT_FLEET_WIRE_DEFLATE",
             config.fleet_wire_deflate)) not in (
                 "0", "false", "False"),
+    }
+
+
+def elastic_defaults(config: SiteConfig = DEFAULT) -> Dict:
+    """The effective elastic-fleet knob set (ISSUE 17): ``config``'s
+    values with per-process ``BLIT_ELASTIC_*`` environment overrides
+    applied — the :func:`stream_defaults` pattern, resolved at
+    FleetController construction so the diurnal bench and chaos drills
+    retune per run."""
+    return {
+        "idle_rps": float(os.environ.get(
+            "BLIT_ELASTIC_IDLE_RPS", config.elastic_idle_rps)),
+        "idle_windows": int(os.environ.get(
+            "BLIT_ELASTIC_IDLE_WINDOWS", config.elastic_idle_windows)),
+        "hysteresis_s": float(os.environ.get(
+            "BLIT_ELASTIC_HYSTERESIS", config.elastic_hysteresis_s)),
+        "warm_timeout_s": float(os.environ.get(
+            "BLIT_ELASTIC_WARM_TIMEOUT", config.elastic_warm_timeout_s)),
+        "warm_hints": int(os.environ.get(
+            "BLIT_ELASTIC_WARM_HINTS", config.elastic_warm_hints)),
+        "min_peers": int(os.environ.get(
+            "BLIT_ELASTIC_MIN_PEERS", config.elastic_min_peers)),
+        "poll_s": float(os.environ.get(
+            "BLIT_ELASTIC_POLL", config.elastic_poll_s)),
+        "drain_timeout_s": float(os.environ.get(
+            "BLIT_ELASTIC_DRAIN_TIMEOUT",
+            config.elastic_drain_timeout_s)),
     }
 
 

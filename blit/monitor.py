@@ -632,6 +632,7 @@ class MetricsPublisher:
                     reasons.append(
                         f"breaker-{row['state'].replace('-', '_')}:"
                         f"{row.get('host')}")
+        status_override: Optional[str] = None
         for name, hook in list(_HEALTH_HOOKS.items()):
             try:
                 state = hook()
@@ -640,7 +641,13 @@ class MetricsPublisher:
             if state and state.get("degraded"):
                 reasons.append(
                     f"{name}:{state.get('reason', 'degraded')}")
-        status = "degraded" if reasons else "ok"
+                # A hook may name the degradation mode — the elastic
+                # controller answers "resizing" mid-flip (ISSUE 17), a
+                # more truthful probe verdict than a generic
+                # "degraded".
+                if state.get("status"):
+                    status_override = str(state["status"])
+        status = (status_override or "degraded") if reasons else "ok"
         return {"ok": not reasons, "status": status, "reasons": reasons,
                 "t": self.clock(), "host": hostname(),
                 "pid": os.getpid(), "seq": self.seq,
@@ -1345,7 +1352,7 @@ def gather_trace_sources(sources: Iterable[str], *,
 # Higher-is-better scalar metrics worth tracking across BENCH rounds.
 _METRIC_KEY_RE = re.compile(
     r"(_gbps|_per_s|_speedup|^async_speedup$|_efficiency|^hit_rate$"
-    r"|_hit_rate$)",
+    r"|_hit_rate$|_attained$)",
 )
 # Lower-is-better scalars (ISSUE 16: the serve plane gates on request
 # latency quantiles) — the noise band inverts for these.

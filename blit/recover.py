@@ -187,6 +187,12 @@ class _LeaseWatch:
     def stalled(self) -> bool:
         return self.seen and self.wd.stalled()
 
+    def fresh(self) -> bool:
+        """Beating and not stale — the elastic controller's standby
+        admissibility check (ISSUE 17): a standby is only worth a warm
+        handoff when its lease is live RIGHT NOW."""
+        return self.seen and not self.wd.stalled()
+
     def age_s(self) -> float:
         return self.wd.age_s()
 

@@ -30,6 +30,12 @@ The HOT-PATH data plane (ISSUE 16) makes the fleet wire fast:
 (:class:`~blit.serve.http.WireError` guards decode) negotiated by
 ``Accept``, and the cache's encoded-wire-body tier so a hot hit never
 re-encodes.
+
+The ELASTIC plane (ISSUE 17) closes the burn-rate→membership loop:
+:class:`FleetController` admits lease-fresh standbys after a
+range-scoped warm handoff when the SLO pages, and drains/retires the
+coldest peer after sustained idle — hysteresis-gated so membership
+never flaps.
 """
 
 from blit.serve.cache import (
@@ -37,6 +43,7 @@ from blit.serve.cache import (
     fingerprint_for,
     reduction_fingerprint,
 )
+from blit.serve.elastic import FleetController
 from blit.serve.fleet import FleetError, FleetFrontDoor
 from blit.serve.http import (
     ConnectionPool,
@@ -58,6 +65,7 @@ __all__ = [
     "Cancelled",
     "ConnectionPool",
     "DeadlineExpired",
+    "FleetController",
     "FleetError",
     "FleetFrontDoor",
     "FrontDoorServer",

@@ -650,15 +650,26 @@ class ProductCache:
         while len(self._hits_by_fp) > self._HOT_TRACK_MAX:
             self._hits_by_fp.popitem(last=False)
 
-    def hot(self, n: int = 16) -> list:
+    def warm_range(self, in_range=None, n: int = 16) -> list:
         """The ``n`` hottest fingerprints as ``(fp, hits)`` pairs,
-        hit-count descending (recency breaks ties) — the fleet plane's
-        cache-warm / drain-hint source (ISSUE 14)."""
+        hit-count descending (recency breaks ties), restricted to the
+        fingerprints ``in_range`` accepts (a predicate; None = all).
+        The range-scoped form serves elastic warm handoff (ISSUE 17):
+        a resize moves exactly one peer's key range, so only entries in
+        that range are worth streaming to the joiner."""
         with self._lock:
             items = list(self._hits_by_fp.items())
         items.reverse()  # most-recent first → stable tie-break
         items.sort(key=lambda kv: kv[1], reverse=True)
+        if in_range is not None:
+            items = [kv for kv in items if in_range(kv[0])]
         return items[:max(0, int(n))]
+
+    def hot(self, n: int = 16) -> list:
+        """The ``n`` hottest fingerprints as ``(fp, hits)`` pairs —
+        the full-keyspace :meth:`warm_range` view (the fleet plane's
+        cache-warm / drain-hint source, ISSUE 14)."""
+        return self.warm_range(None, n)
 
     def contains(self, fp: str) -> bool:
         with self._lock:

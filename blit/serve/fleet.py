@@ -132,13 +132,23 @@ class _HttpWatch:
     def stalled(self) -> bool:
         return self.seen and self.wd.stalled()
 
+    def fresh(self) -> bool:
+        """Beating and not stale (the LeaseWatch surface, ISSUE 17)."""
+        return self.seen and not self.wd.stalled()
+
     def age_s(self) -> float:
         return self.wd.age_s()
 
 
 class _Peer:
     """One peer's routing state: breaker, live latency histogram,
-    lease/HTTP liveness watch, last fetched health document."""
+    lease/HTTP liveness watch, last fetched health document.
+
+    ``standby`` marks an elastic-capacity peer (ISSUE 17): process up,
+    lease beating, deliberately NOT in the ring until the controller
+    admits it.  ``retired`` marks a peer scaled in on purpose — neither
+    is a casualty, so the liveness loop must not auto-rejoin them and
+    the health document must not count them degraded."""
 
     def __init__(self, name: str, url: str, watch, *,
                  breaker_threshold: int, breaker_cooldown_s: float):
@@ -149,6 +159,8 @@ class _Peer:
                                       cooldown_s=breaker_cooldown_s)
         self.hist = HistogramStats()
         self.in_ring = True
+        self.standby = False
+        self.retired = False
         self.last_health: Optional[Dict] = None
         self.requests = 0
         self.failures = 0
@@ -157,6 +169,8 @@ class _Peer:
         return {
             "url": self.url,
             "in_ring": self.in_ring,
+            "standby": self.standby,
+            "retired": self.retired,
             "breaker": self.breaker.snapshot()["state"],
             "requests": self.requests,
             "failures": self.failures,
@@ -222,20 +236,19 @@ class FleetFrontDoor:
         self.lease_dir = lease_dir
         self.ring = HashRing(peers, vnodes=d["vnodes"],
                              replicas=self.replicas)
+        self._breaker_threshold = config.breaker_threshold
+        self._breaker_cooldown_s = config.breaker_cooldown_s
         self._peers: Dict[str, _Peer] = {}
         for i, (name, url) in enumerate(peers.items()):
-            if lease_dir is not None:
-                from blit.recover import LeaseWatch
-
-                proc = (proc_of or {}).get(name, i)
-                watch = LeaseWatch(lease_dir, proc, self.peer_ttl_s,
-                                   grace_s=self.peer_ttl_s)
-            else:
-                watch = _HttpWatch(name, self.peer_ttl_s)
+            proc = (proc_of or {}).get(name, i)
             self._peers[name] = _Peer(
-                name, url, watch,
-                breaker_threshold=config.breaker_threshold,
-                breaker_cooldown_s=config.breaker_cooldown_s)
+                name, url, self._make_watch(name, proc),
+                breaker_threshold=self._breaker_threshold,
+                breaker_cooldown_s=self._breaker_cooldown_s)
+        # Elastic resize state (ISSUE 17): set by the FleetController
+        # around a membership flip; health() answers "resizing" while
+        # it is non-None.
+        self.resize_reason: Optional[str] = None
         self._lock = threading.Lock()
         self._drain_cond = threading.Condition(self._lock)
         self._inflight = 0
@@ -255,6 +268,14 @@ class FleetFrontDoor:
         # BLIT_REQUEST_LOG / SiteConfig.request_log_dir is set.
         # (request_log_for also applies the config's exemplars knob.)
         self.request_log = observability.request_log_for("door", config)
+
+    def _make_watch(self, name: str, proc: int):
+        if self.lease_dir is not None:
+            from blit.recover import LeaseWatch
+
+            return LeaseWatch(self.lease_dir, proc, self.peer_ttl_s,
+                              grace_s=self.peer_ttl_s)
+        return _HttpWatch(name, self.peer_ttl_s)
 
     # -- liveness ----------------------------------------------------------
     def start(self) -> "FleetFrontDoor":
@@ -288,7 +309,11 @@ class FleetFrontDoor:
                 self._fetch_health(p)
             if p.in_ring and p.watch.stalled():
                 self._eject(p, f"lease stale {p.watch.age_s():.2f}s")
-            elif not p.in_ring and p.watch.seen and not p.watch.stalled():
+            elif (not p.in_ring and not p.standby and not p.retired
+                  and p.watch.seen and not p.watch.stalled()):
+                # Standby and retired peers are out of the ring ON
+                # PURPOSE (ISSUE 17) — only the elastic controller
+                # admits them; a fresh lease alone must not.
                 self._rejoin(p)
 
     def _fetch_health(self, p: _Peer) -> None:
@@ -310,6 +335,10 @@ class FleetFrontDoor:
         if not self.ring.remove(p.name):
             return
         p.in_ring = False
+        # Sever the idle keep-alives to the departed peer (ISSUE 17
+        # satellite): a pooled socket to a dead host would eat one
+        # failed write per request until the LIFO stack drained.
+        self.pool.evict_peer(p.url)
         self.timeline.count("fleet.eject")
         # Detection latency (the chaos drill's budget assertion): how
         # stale the lease was when we acted — age at detection, the
@@ -328,6 +357,63 @@ class FleetFrontDoor:
         self.timeline.count("fleet.rejoin")
         flight_recorder().event("fleet", "rejoin", peer=p.name)
         log.warning("fleet: peer %s rejoined the ring", p.name)
+
+    # -- elastic membership (ISSUE 17) -------------------------------------
+    def add_standby(self, name: str, url: str, *,
+                    proc: Optional[int] = None) -> _Peer:
+        """Pre-register an elastic standby: lease-watched like any peer
+        (its beats are observed, its health fetched) but NOT in the
+        ring — no request routes to it until :meth:`admit_peer`.
+        ``proc`` is its lease proc index (default: registration
+        order)."""
+        with self._lock:
+            idx = proc if proc is not None else len(self._peers)
+            p = _Peer(name, url.rstrip("/"), self._make_watch(name, idx),
+                      breaker_threshold=self._breaker_threshold,
+                      breaker_cooldown_s=self._breaker_cooldown_s)
+            p.in_ring = False
+            p.standby = True
+            self._peers[name] = p
+        self.timeline.count("fleet.standby")
+        flight_recorder().event("fleet", "standby", peer=name)
+        return p
+
+    def admit_peer(self, name: str) -> bool:
+        """Flip a standby (or retired) peer INTO the ring — the elastic
+        scale-out membership flip, called by the FleetController only
+        after the warm handoff acked or its deadline burned."""
+        p = self._peers[name]
+        p.standby = False
+        p.retired = False
+        if not self.ring.add(name):
+            return False
+        p.in_ring = True
+        p.breaker.record_success()  # fresh start: the controller vouches
+        self.timeline.count("fleet.admit")
+        flight_recorder().event("fleet", "admit", peer=name)
+        log.warning("fleet: peer %s admitted to the ring (scale-out); "
+                    "%d peer(s)", name, len(self.ring))
+        return True
+
+    def retire_peer(self, name: str) -> bool:
+        """Remove a drained peer from the ring ON PURPOSE — the elastic
+        scale-in flip.  Unlike ejection this is not a casualty: the
+        peer is marked ``retired`` so a still-beating lease cannot
+        auto-rejoin it, and its pooled keep-alives are severed so no
+        later request is written to a departed peer's dead socket."""
+        p = self._peers[name]
+        p.retired = True
+        p.standby = False
+        removed = self.ring.remove(name)
+        p.in_ring = False
+        self.pool.evict_peer(p.url)
+        if removed:
+            self.timeline.count("fleet.retire")
+            flight_recorder().event("fleet", "retire", peer=name)
+            log.warning("fleet: peer %s retired from the ring "
+                        "(scale-in); %d peer(s) remain", name,
+                        len(self.ring))
+        return removed
 
     # -- routing -----------------------------------------------------------
     def _remaining(self, t0: float,
@@ -695,6 +781,27 @@ class FleetFrontDoor:
                       observability.tracer().context()),
                 name="blit-fleet-warm", daemon=True).start()
 
+    def warm_hints(self, in_range=None, limit: int = 32
+                   ) -> List[Tuple[str, Dict]]:
+        """The hottest ``(fp, recipe)`` pairs the door knows, hottest
+        first, restricted to the fingerprints ``in_range`` accepts (a
+        predicate; None = all) — the drain-time hint source (ISSUE 14),
+        range-scoped for elastic warm handoff (ISSUE 17) so a joiner is
+        streamed exactly its incoming key range."""
+        with self._lock:
+            items = sorted(self._hot.items(), key=lambda kv: kv[1][0],
+                           reverse=True)
+        out: List[Tuple[str, Dict]] = []
+        for fp, (_, recipe) in items:
+            if recipe is None:
+                continue
+            if in_range is not None and not in_range(fp):
+                continue
+            out.append((fp, recipe))
+            if len(out) >= max(0, int(limit)):
+                break
+        return out
+
     def _send_warm(self, peers: List[_Peer], recipes: List[Dict],
                    ctx: Optional[Dict] = None) -> None:
         # Warm hints carry the hot request's trace (ISSUE 15): the
@@ -722,18 +829,32 @@ class FleetFrontDoor:
         with self._lock:
             if self._draining:
                 own.append("draining")
+            resizing = self.resize_reason
+        standbys: List[str] = []
         peer_health: Dict[str, Optional[Dict]] = {}
         for name, p in sorted(self._peers.items()):
             if not p.in_ring:
-                own.append(f"peer-ejected:{name}")
+                if p.standby:
+                    standbys.append(name)  # capacity, not a casualty
+                elif not p.retired:  # retired = deliberate scale-in
+                    own.append(f"peer-ejected:{name}")
                 continue
             state = p.breaker.snapshot()["state"]
             if state != "closed":
                 own.append(f"breaker-{state.replace('-', '_')}:{name}")
             peer_health[name] = p.last_health
+        if resizing:
+            own.append(f"resizing:{resizing}")
         doc = fold_health(own, peer_health)
         doc["ring"] = self.ring.peers()
         doc["peers_total"] = len(self._peers)
+        doc["standbys"] = standbys
+        if resizing:
+            # Honest mid-flip status (ISSUE 17 satellite): routing is
+            # transiently degraded while membership flips — "ok" here
+            # would lie to the probe that decides where traffic goes.
+            doc["ok"] = False
+            doc["status"] = "resizing"
         if not len(self.ring):
             doc["ok"] = False
             doc["status"] = "down"
@@ -746,8 +867,8 @@ class FleetFrontDoor:
             inflight = self._inflight
         rep = self.timeline.report()
         counters = {k: row["calls"] for k, row in rep.items()
-                    if k.startswith("fleet.") and isinstance(row, dict)
-                    and "calls" in row}
+                    if k.startswith(("fleet.", "elastic."))
+                    and isinstance(row, dict) and "calls" in row}
         return {
             "peers": {n: p.snapshot()
                       for n, p in sorted(self._peers.items())},
@@ -788,12 +909,8 @@ class FleetFrontDoor:
                                 self._inflight)
                     break
                 self._drain_cond.wait(timeout=0.1)
-            hottest = sorted(self._hot.items(), key=lambda kv: kv[1][0],
-                             reverse=True)[:max(0, int(hints))]
         per_peer: Dict[str, List[Dict]] = {}
-        for fp, (_, recipe) in hottest:
-            if recipe is None:
-                continue
+        for fp, recipe in self.warm_hints(limit=hints):
             for name in self.ring.owners(fp):
                 per_peer.setdefault(name, []).append(recipe)
         sent = 0

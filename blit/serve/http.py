@@ -333,6 +333,26 @@ class ConnectionPool:
             return {f"{h}:{p}": len(c)
                     for (h, p), c in self._idle.items() if c}
 
+    def evict_peer(self, url: str) -> int:
+        """Sever and drop every idle socket to ``url``'s host — called
+        when a peer leaves the ring (ejection or elastic scale-in,
+        ISSUE 17) so no later request is written to a departed peer's
+        dead keep-alive.  Counts ``fleet.pool.evict`` per socket;
+        returns how many were evicted."""
+        from urllib.parse import urlsplit
+
+        parts = urlsplit(url)
+        key = (parts.hostname or "127.0.0.1", parts.port or 80)
+        with self._lock:
+            conns = self._idle.pop(key, [])
+        for c in conns:
+            self._count("fleet.pool.evict")
+            try:
+                c.close()
+            except OSError:
+                pass
+        return len(conns)
+
     def close(self) -> None:
         with self._lock:
             self._closed = True
@@ -843,28 +863,57 @@ class PeerServer:
         cache, never an error.  The peer's own cache/single-flight
         machinery dedupes repeats.  Warm reductions parent onto the
         hinting door's trace (ISSUE 15) so replication work is
-        attributable to the request that made the entry hot."""
+        attributable to the request that made the entry hot.
+
+        Elastic warm handoff (ISSUE 17) sends ``wait_s``: the response
+        then blocks until the accepted recipes complete (or the budget
+        burns), answering ``completed`` / ``bytes`` / ``timed_out`` —
+        the joiner's warm-completion ack the controller gates the
+        membership flip on.  ``priority`` overrides the default 9 so a
+        handoff outranks background replication."""
         accepted = rejected = 0
+        tickets: List = []
         from blit.serve.service import ProductRequest
 
+        try:
+            priority = int(doc.get("priority", 9))
+        except (TypeError, ValueError):
+            priority = 9
         tr = observability.tracer()
         with tr.activate(trace_context_from(headers)):
             for recipe in (doc.get("recipes") or []):
                 with self._counts_lock:
                     self.counts["warm"] += 1
                 try:
-                    self.service.submit(ProductRequest.from_recipe(recipe),
-                                        priority=9, client="fleet-warm")
+                    tickets.append(self.service.submit(
+                        ProductRequest.from_recipe(recipe),
+                        priority=priority, client="fleet-warm"))
                     accepted += 1
                 except Exception:  # noqa: BLE001 — warming is best-effort
                     rejected += 1
         self.service.timeline.count("serve.warm", accepted)
+        out = {"accepted": accepted, "rejected": rejected}
+        wait_s = doc.get("wait_s")
+        if wait_s is not None:
+            completed, warm_bytes, timed_out = 0, 0, False
+            deadline = time.monotonic() + max(0.0, float(wait_s))
+            for t in tickets:
+                try:
+                    _, data = self.service.result(
+                        t, timeout=max(0.0, deadline - time.monotonic()))
+                    completed += 1
+                    warm_bytes += int(getattr(data, "nbytes", 0) or 0)
+                except TimeoutError:
+                    timed_out = True  # budget burned; rest stay queued
+                    break
+                except Exception:  # noqa: BLE001 — a failed warm is cold
+                    pass
+            out.update(completed=completed, bytes=warm_bytes,
+                       timed_out=timed_out)
         # /warm negotiates like /product (ISSUE 16) — its 202 body is
         # JSON either way (recipes in, counts out: nothing to frame),
         # so the header honestly answers "json" even to binary askers.
-        return _json_resp(202, {"accepted": accepted,
-                                "rejected": rejected},
-                          {WIRE_HEADER: "json"})
+        return _json_resp(202, out, {WIRE_HEADER: "json"})
 
     # -- surfaces ----------------------------------------------------------
     def health(self) -> Dict:

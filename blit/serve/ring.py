@@ -163,3 +163,26 @@ class HashRing:
             if self.owner(k) != other.owner(k):
                 moved += 1
         return moved, total
+
+    # -- resize deltas (ISSUE 17) ------------------------------------------
+    def incoming_keys(self, joiner: str,
+                      keys: Iterable[str]) -> List[str]:
+        """The subset of ``keys`` whose ownership would MOVE to
+        ``joiner`` if it joined now — the scale-out warm-handoff range.
+        Pure: computed on a shadow ring, this ring is not mutated.  By
+        minimal movement these are the ONLY keys that move, so warming
+        exactly this range makes the membership flip hit-rate neutral.
+        A ``joiner`` already present owns its current keys."""
+        members = self.peers()
+        if joiner not in members:
+            members.append(joiner)
+        shadow = HashRing(members, vnodes=self.vnodes,
+                          replicas=self.replicas)
+        return [k for k in keys if shadow.owner(k) == joiner]
+
+    def departing_keys(self, leaver: str,
+                       keys: Iterable[str]) -> List[str]:
+        """The subset of ``keys`` ``leaver`` currently owns — exactly
+        what moves to the clockwise successors when it leaves (the
+        scale-in pre-warm range)."""
+        return [k for k in keys if self.owner(k) == leaver]

@@ -140,6 +140,39 @@ class TestFaults:
             pool.close()
 
 
+class TestEvictPeer:
+    def test_drained_then_removed_peer_sockets_are_severed(
+            self, echo_server):
+        # The ISSUE 17 satellite: a peer that is drained and REMOVED
+        # from the ring leaves pooled keep-alive sockets behind;
+        # evict_peer must sever exactly those so no later request is
+        # written to a departed peer's dead socket.
+        tl = Timeline()
+        pool = ConnectionPool(max_per_peer=4, timeline=tl)
+        try:
+            http_json("GET", echo_server, "/x", pool=pool)
+            assert sum(pool.stats().values()) == 1
+            n = pool.evict_peer(echo_server)
+            assert n == 1
+            assert sum(pool.stats().values()) == 0
+            rep = tl.report()
+            assert rep["fleet.pool.evict"]["calls"] == 1
+            # The pool still serves the (rejoined) peer: a FRESH dial,
+            # never the severed socket.
+            st, _, _ = http_json("GET", echo_server, "/y", pool=pool)
+            assert st == 200
+            assert tl.report()["fleet.pool.open"]["calls"] == 2
+        finally:
+            pool.close()
+
+    def test_evict_unknown_peer_is_a_noop(self):
+        pool = ConnectionPool(timeline=Timeline())
+        try:
+            assert pool.evict_peer("http://127.0.0.1:1") == 0
+        finally:
+            pool.close()
+
+
 class TestNoBodyBleed:
     def test_concurrent_distinct_bodies(self, echo_server):
         # Many threads hammer one pool with distinct payloads; every

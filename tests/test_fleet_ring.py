@@ -5,6 +5,7 @@ PYTHONHASHSEED-poisoned ``hash()``), and replica sets that never
 collapse onto one host."""
 
 import json
+import random
 import subprocess
 import sys
 
@@ -63,6 +64,74 @@ class TestMinimalMovement:
         ring.remove(PEERS[2])
         ring.add(PEERS[2])
         assert {k: ring.owner(k) for k in KEYS[:500]} == want
+
+
+class TestResizeDeltas:
+    """The elastic plane's ring arithmetic (ISSUE 17): incoming_keys /
+    departing_keys predict EXACTLY the keys a membership flip moves —
+    the warm handoff streams that set and nothing else."""
+
+    def test_incoming_keys_match_a_real_join(self):
+        ring = HashRing(PEERS[:-1], vnodes=128)
+        predicted = ring.incoming_keys(PEERS[-1], KEYS)
+        grown = HashRing(PEERS[:-1], vnodes=128)
+        grown.add(PEERS[-1])
+        assert predicted == [k for k in KEYS
+                             if grown.owner(k) == PEERS[-1]]
+        assert predicted  # the joiner takes a real share
+
+    def test_departing_keys_are_the_leavers_share(self):
+        ring = HashRing(PEERS, vnodes=128)
+        dep = ring.departing_keys(PEERS[3], KEYS)
+        assert len(dep) == ring.spread(KEYS)[PEERS[3]]
+        assert all(ring.owner(k) == PEERS[3] for k in dep)
+
+    def test_incoming_of_a_member_is_its_current_share(self):
+        # Asking "what would move to X" when X is already in the ring
+        # must answer X's existing share — the shadow ring is the ring.
+        ring = HashRing(PEERS, vnodes=128)
+        assert ring.incoming_keys(PEERS[2], KEYS) == \
+            ring.departing_keys(PEERS[2], KEYS)
+
+
+class TestResizeChurn:
+    def test_random_resize_sequences_move_only_flipped_keys(self):
+        # The churn property (ISSUE 17 satellite): N seeded random
+        # join/leave sequences; after EVERY step the set of keys whose
+        # owner changed is EXACTLY the predicted incoming/departing
+        # set, and the uniform-spread bound survives the churn.
+        rng = random.Random(1234)
+        keys = KEYS[:1500]
+        for trial in range(5):
+            members = [f"t{trial}-peer{i}" for i in range(5)]
+            spares = [f"t{trial}-spare{j}" for j in range(6)]
+            ring = HashRing(members, vnodes=128)
+            for step in range(8):
+                before = {k: ring.owner(k) for k in keys}
+                join = spares and (rng.random() < 0.5
+                                   or len(members) <= 2)
+                if join:
+                    peer = spares.pop()
+                    predicted = set(ring.incoming_keys(peer, keys))
+                    ring.add(peer)
+                    members.append(peer)
+                    changed = {k for k in keys
+                               if ring.owner(k) != before[k]}
+                    assert changed == predicted, (trial, step, peer)
+                    assert all(ring.owner(k) == peer for k in changed)
+                else:
+                    peer = members.pop(rng.randrange(len(members)))
+                    predicted = set(ring.departing_keys(peer, keys))
+                    ring.remove(peer)
+                    changed = {k for k in keys
+                               if ring.owner(k) != before[k]}
+                    assert changed == predicted, (trial, step, peer)
+                    assert all(before[k] == peer for k in changed)
+                spread = ring.spread(keys)
+                fair = len(keys) / len(ring)
+                for nm, n in spread.items():
+                    assert 0.35 * fair <= n <= 2.3 * fair, \
+                        (trial, step, nm, n, fair)
 
 
 class TestDeterminism:
