@@ -1822,10 +1822,24 @@ def _cmd_ingest_bench(args: argparse.Namespace) -> int:
         of wall time — replay pacing is meaningless on a microsecond
         recording.  ``drill=True`` is the seeded late-chunk drill: one
         chunk held past a tightened lateness budget, proving the product
-        masks (and flight-records) instead of wedging."""
-        from blit.observability import Timeline
-        from blit.stream import ReplaySource, stream_reduce
+        masks (and flight-records) instead of wedging.
 
+        With ``--packets`` (ISSUE 18) the replay goes through the
+        PACKET front end — the recording framed as datagrams, with the
+        ``--packet-drop``/``--packet-reorder``/``--packet-dup``
+        schedules applied — so the leg measures the sustained-capture
+        contract: 1× for the whole session, back-pressure shedding as
+        masked gaps (counted in the report), never a stall.  The stall
+        watchdog is ARMED, so a completed leg IS the zero-stall proof
+        (``stalls`` would have been a raised incident, not a number)."""
+        from blit.observability import Timeline
+        from blit.stream import (
+            PacketReplaySource,
+            ReplaySource,
+            stream_reduce,
+        )
+
+        packets = bool(getattr(args, "packets", False))
         nblocks = max(4, args.blocks)
         ntime = (args.chunks * args.chunk_frames + 3) * args.nfft
         per_block = -(-ntime // nblocks)
@@ -1844,10 +1858,24 @@ def _cmd_ingest_bench(args: argparse.Namespace) -> int:
             # masked (zero weight) while the stream keeps flowing.
             lateness = 0.02 * args.live_seconds
             late = {1: 0.8 * args.live_seconds}
-        src = ReplaySource(live_raw, rate=args.live_rate, late=late)
+        if packets:
+            src = PacketReplaySource(
+                live_raw, rate=args.live_rate,
+                packet_ntime=args.packet_ntime,
+                drop=(args.packet_drop or None),
+                reorder=args.packet_reorder, dup=args.packet_dup,
+                seed=0, timeline=tl)
+            # The sustained-capture leg must complete masked, not
+            # wedged: a whole-stream lateness stall would hide behind
+            # the default budget, so bound it by the recording span.
+            lateness = lateness or 0.25 * args.live_seconds
+        else:
+            src = ReplaySource(live_raw, rate=args.live_rate, late=late)
         out = os.path.join(td, "live_drill.fil" if drill else "live.fil")
         t0 = _time.perf_counter()
-        hdr = stream_reduce(src, out, reducer=red, lateness_s=lateness)
+        hdr = stream_reduce(src, out, reducer=red, lateness_s=lateness,
+                            stall_timeout_s=max(5.0,
+                                                2 * args.live_seconds))
         wall = _time.perf_counter() - t0
         lat = tl.report().get("hists", {}).get(
             "stream.chunk_to_product_s", {})
@@ -1865,7 +1893,12 @@ def _cmd_ingest_bench(args: argparse.Namespace) -> int:
             # sample — the clean path must report 0 here.
             "degraded_spectra": hdr["stream_degraded_spectra"],
             "product_bytes": os.path.getsize(out),
+            # The armed watchdog raised on any stall, so reaching this
+            # line proves zero.
+            "stalls": 0,
         }
+        if packets:
+            leg["packet"] = src.packet_report()
         if hdr.get("stream_flight_dump"):
             leg["flight_dump"] = hdr["stream_flight_dump"]
         return leg
@@ -2703,6 +2736,58 @@ def _chaos_fleet_resize(args: argparse.Namespace, work: str,
     return 0 if ok else 1
 
 
+def _cmd_session(args: argparse.Namespace) -> int:
+    """``blit session`` (ISSUE 18): run (or rejoin) a whole LIVE
+    observing session from a spec file — one supervised stream consumer
+    per recorder seat, fanned across this host, each crash-rejoinable
+    through its StreamCursor.  The spec is JSON::
+
+        {"seats": [{"name": "blc00", "out": "...", "raw": "...",
+                    "source": {"kind": "packet", "port": 60000},
+                    "knobs": {"nfft": 1024}}, ...],
+         "work_dir": "...", "lease_ttl_s": 5.0}
+
+    (seat/source fields: :class:`blit.stream.SessionSupervisor` /
+    :func:`blit.stream.source_from_spec`).  Re-running the same spec
+    after a host crash REJOINS every seat mid-product.  Prints the
+    folded session report; exit 0 = every seat completed."""
+    import tempfile
+
+    from blit.observability import Timeline
+    from blit.stream import SessionSupervisor
+
+    with open(args.spec) as f:
+        spec = json.load(f)
+    tl = Timeline()
+    pub = _monitor_from_flags(args)
+    work = (args.work_dir or spec.get("work_dir")
+            or tempfile.mkdtemp(prefix="blit-session-"))
+    sup = SessionSupervisor(
+        spec["seats"], work_dir=work,
+        lease_ttl_s=(args.lease_ttl if args.lease_ttl is not None
+                     else spec.get("lease_ttl_s")),
+        poll_s=(args.poll if args.poll is not None
+                else spec.get("poll_s")),
+        max_attempts=(args.attempts if args.attempts is not None
+                      else spec.get("max_attempts")),
+        faults=spec.get("faults"), timeline=tl,
+    )
+    rep = sup.run()
+    rep["work_dir"] = work
+    if pub is not None:
+        pub.tick()
+        rep["monitor"] = {"port": pub.port, "spool": pub.spool_path}
+        from blit import monitor
+
+        monitor.shutdown_publisher()
+    body = json.dumps(rep)
+    print(body)
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            f.write(body)
+    return 0 if rep["ok"] else 1
+
+
 def _cmd_chaos(args: argparse.Namespace) -> int:
     """``blit chaos`` (ISSUE 12): run a SEEDED kill/hang schedule
     against a real supervised workload — a multi-process sharded scan
@@ -2740,8 +2825,17 @@ def _cmd_chaos(args: argparse.Namespace) -> int:
         print("--fault resize requires --fleet (an elastic membership "
               "flip is a serving-fleet failure shape)", file=sys.stderr)
         return 2
-    point = args.point or ("stream.chunk" if args.workload == "stream"
-                           else "mesh.window")
+    if args.fault == "reorder" and args.workload != "stream":
+        print("--fault reorder requires --workload stream (wire "
+              "reordering is a packet front-end failure shape)",
+              file=sys.stderr)
+        return 2
+    use_packets = args.workload == "stream" and (
+        args.packets or args.fault == "reorder")
+    point = args.point or (
+        "packet.recv" if args.fault == "reorder"
+        else "stream.chunk" if args.workload == "stream"
+        else "mesh.window")
     if args.fault == "corrupt":
         # The integrity leg (ISSUE 13) is its own drill shape: no
         # supervisor, no crash — a corrupted delivered frame must be
@@ -2758,21 +2852,44 @@ def _cmd_chaos(args: argparse.Namespace) -> int:
         raw = os.path.join(work, "chaos.raw")
         nblocks = max(4, args.chunks)
         ntime = (args.chunks * args.window_frames + 3) * args.nfft
-        synth_raw(raw, nblocks=nblocks, obsnchan=args.nchan,
-                  ntime_per_block=-(-ntime // nblocks), seed=args.seed)
+        hdr0, blocks = synth_raw(
+            raw, nblocks=nblocks, obsnchan=args.nchan,
+            ntime_per_block=-(-ntime // nblocks), seed=args.seed)
         out = os.path.join(work, "chaos.fil")
         oracle = os.path.join(work, "oracle.fil")
         from blit.pipeline import RawReducer
 
+        source = None
+        oracle_raw = raw
+        if use_packets:
+            # The packet drill's seeded schedule: with --packets, one
+            # whole block is dropped off the wire — the oracle is then
+            # the SAME recording with that block zero-filled (gap ≡
+            # mask ≡ zero weight, the acceptance identity).  A plain
+            # --fault reorder keeps every packet, so the clean batch
+            # oracle stands.
+            source = {"kind": "packet-replay", "raw": raw,
+                      "rate": args.replay_rate,
+                      "packet_ntime": args.packet_ntime,
+                      "seed": args.seed}
+            if args.packets:
+                from blit.io.guppi import write_raw
+
+                source.update(drop_blocks=[1], reorder=0.15, dup=0.05)
+                report["gapped_blocks"] = [1]
+                zb = [b.copy() for b in blocks]
+                zb[1][:] = 0
+                oracle_raw = os.path.join(work, "chaos_zeroed.raw")
+                write_raw(oracle_raw, hdr0, zb)
         RawReducer(nfft=args.nfft, nint=args.nint,
                    chunk_frames=args.window_frames,
-                   tune_online=False).reduce_to_file(raw, oracle)
+                   tune_online=False).reduce_to_file(oracle_raw, oracle)
         sup = StreamSupervisor(
             raw, out, kind="reduce",
             knobs=dict(nfft=args.nfft, nint=args.nint,
                        chunk_frames=args.window_frames,
                        tune_online=False),
-            replay_rate=args.replay_rate, faults=fault,
+            replay_rate=args.replay_rate, source=source, faults=fault,
             lease_ttl_s=args.lease_ttl, poll_s=args.poll,
             max_attempts=args.attempts, timeline=tl,
         )
@@ -2877,7 +2994,12 @@ def _cmd_chaos(args: argparse.Namespace) -> int:
     if args.json_out:
         with open(args.json_out, "w") as f:
             f.write(body)
-    ok = report["recovered"] and identical
+    # Only the process-grade faults demand a RECOVERY (a restart to
+    # detect); a data-plane fault like reorder is absorbed in place —
+    # there, "no error and byte-identical" IS the pass.
+    crashy = args.fault in ("kill", "hang")
+    ok = identical and (report["recovered"] if crashy
+                        else not rep.get("error"))
     return 0 if ok else 1
 
 
@@ -3279,6 +3401,32 @@ def main(argv: Optional[List[str]] = None) -> int:
     _add_monitor_flags(pl)
     pl.set_defaults(fn=_cmd_stream)
 
+    pv = sub.add_parser(
+        "session",
+        help="run (or rejoin) a whole LIVE observing session from a "
+             "spec file: one supervised stream consumer per recorder "
+             "seat, packet capture included (ISSUE 18)",
+    )
+    pv.add_argument("spec",
+                    help="session spec JSON: {\"seats\": [{name, out, "
+                         "source, knobs...}], ...} — see `blit.stream."
+                         "SessionSupervisor`")
+    pv.add_argument("--work-dir", default=None,
+                    help="session lease/spec scratch dir (default: the "
+                         "spec's work_dir, else a fresh temp dir); "
+                         "re-use it to rejoin after a crash")
+    pv.add_argument("--lease-ttl", type=float, default=None,
+                    help="per-seat heartbeat lease TTL in seconds (the "
+                         "seat-death detection budget)")
+    pv.add_argument("--poll", type=float, default=None,
+                    help="seat supervisor watch cadence")
+    pv.add_argument("--attempts", type=int, default=None,
+                    help="per-seat recovery attempt budget")
+    pv.add_argument("--json-out", default=None,
+                    help="also write the session report JSON here")
+    _add_monitor_flags(pv)
+    pv.set_defaults(fn=_cmd_session)
+
     ps = sub.add_parser(
         "scan", help="whole (session, scan) → per-band products via the mesh"
     )
@@ -3418,6 +3566,26 @@ def main(argv: Optional[List[str]] = None) -> int:
     pg.add_argument("--live-seconds", type=float, default=0.5,
                     help="wall-clock span the live recording is "
                          "stretched to cover (TBIN-scaled)")
+    pg.add_argument("--packets", action="store_true",
+                    help="run the --live leg through the PACKET front "
+                         "end (ISSUE 18): the recording framed as "
+                         "datagrams via PacketReplaySource, gaps "
+                         "masked not stalled; the leg reports the "
+                         "packet gap/reorder/dup counters and block "
+                         "assembly tails beside chunk→product latency")
+    pg.add_argument("--packet-ntime", type=int, default=None,
+                    help="time samples per DATA packet (default "
+                         "SiteConfig/BLIT_PACKET_NTIME)")
+    pg.add_argument("--packet-drop", type=float, default=0.0,
+                    help="seeded fraction of DATA packets dropped in "
+                         "the --packets leg (a partial block becomes a "
+                         "masked gap)")
+    pg.add_argument("--packet-reorder", type=float, default=0.0,
+                    help="seeded fraction of DATA packets deferred out "
+                         "of order in the --packets leg")
+    pg.add_argument("--packet-dup", type=float, default=0.0,
+                    help="seeded fraction of DATA packets duplicated "
+                         "in the --packets leg")
     pg.add_argument("--live-drill", action="store_true",
                     help="also run the seeded late-chunk drill: one "
                          "chunk past a tightened lateness budget must "
@@ -3618,7 +3786,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "supervised sharded search, or a live consumer")
     pc.add_argument("--fault", default="kill",
                     choices=["kill", "hang", "corrupt", "partition",
-                             "resize"],
+                             "resize", "reorder"],
                     help="the injected failure mode (corrupt = the "
                          "ISSUE 13 integrity leg: a bit-flipped "
                          "delivered RAW frame under a digest sidecar "
@@ -3628,7 +3796,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "only: SIGKILL a serving peer DURING the "
                          "elastic warm handoff, the flip must still "
                          "complete with byte-identical answers, "
-                         "ISSUE 17)")
+                         "ISSUE 17; reorder = stream workload only, "
+                         "ISSUE 18: hold packets back at the "
+                         "packet.recv point — the assembler must "
+                         "repair the order with the product "
+                         "byte-identical and no crash)")
     pc.add_argument("--fleet", action="store_true",
                     help="break a SERVING fleet instead (ISSUE 14): "
                          "SIGKILL/SIGSTOP a real fleet-peer subprocess "
@@ -3673,6 +3845,17 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "spans")
     pc.add_argument("--replay-rate", type=float, default=200.0,
                     help="stream workload replay speed")
+    pc.add_argument("--packets", action="store_true",
+                    help="feed the stream workload through the PACKET "
+                         "front end (ISSUE 18): a PacketReplaySource "
+                         "with a seeded whole-block drop + "
+                         "reorder/dup schedule — the drill then also "
+                         "asserts the gapped block is MASKED "
+                         "(byte-identical to the zero-filled oracle), "
+                         "and a --fault kill rejoins through the "
+                         "packet source")
+    pc.add_argument("--packet-ntime", type=int, default=64,
+                    help="time samples per DATA packet (--packets)")
     pc.add_argument("--lease-ttl", type=float, default=3.0,
                     help="heartbeat lease TTL (the detection budget)")
     pc.add_argument("--poll", type=float, default=0.1,

@@ -135,6 +135,24 @@ class SiteConfig:
     stream_poll_s: float = 0.05
     stream_idle_timeout_s: Optional[float] = None
     stream_stall_timeout_s: Optional[float] = None
+    # Recorder packet front end (blit/stream/packet.py; ISSUE 18).
+    # packet_host/packet_port is where a PacketSource listens (port 0 =
+    # ephemeral, read it back from the source); packet_rcvbuf_bytes
+    # sizes SO_RCVBUF — a recorder never pauses, so the kernel buffer
+    # is the only back-pressure before packets shed as gaps;
+    # packet_ntime is the framer's time samples per DATA packet (all
+    # channels per packet: with nchan=64 npol=2 that is 8 KiB of
+    # payload at the default — under the common 9000-byte jumbo MTU);
+    # packet_horizon_blocks is the assembler's reorder horizon — a
+    # partial block is abandoned (masked downstream) once packets
+    # arrive that many blocks past it.  Per-process overrides:
+    # BLIT_PACKET_HOST / BLIT_PACKET_PORT / BLIT_PACKET_RCVBUF /
+    # BLIT_PACKET_NTIME / BLIT_PACKET_HORIZON (:func:`packet_defaults`).
+    packet_host: str = "127.0.0.1"
+    packet_port: int = 60000
+    packet_rcvbuf_bytes: int = 32 << 20
+    packet_ntime: int = 64
+    packet_horizon_blocks: int = 2
     # Ingest performance plane (blit/tune.py + blit/hostmem.py; ISSUE 8).
     # tune_dir overrides where per-rig tuning profiles live (None = the
     # BLIT_TUNE_DIR env, else ~/.cache/blit/tune); staging_pool_bytes is
@@ -185,6 +203,11 @@ class SiteConfig:
     slo_serve_wait_p99_s: Optional[float] = None
     slo_stream_latency_p99_s: Optional[float] = None
     slo_ingest_gbps_floor: Optional[float] = None
+    # Sustained-capture objective (ISSUE 18): ceiling on packet block
+    # assembly p99 (first packet → complete block) — burning it means
+    # the wire is reordering/dropping harder than the horizon absorbs.
+    # Env: BLIT_SLO_PACKET_P99.
+    slo_packet_assembly_p99_s: Optional[float] = None
     slo_budget: float = 0.01
     slo_fast_burn: float = 14.0
     slo_slow_burn: float = 2.0
@@ -392,6 +415,24 @@ def stream_defaults(config: SiteConfig = DEFAULT) -> Dict:
     }
 
 
+def packet_defaults(config: SiteConfig = DEFAULT) -> Dict:
+    """The effective packet-capture knob set (ISSUE 18): ``config``'s
+    values with per-process ``BLIT_PACKET_*`` environment overrides
+    applied — the :func:`stream_defaults` pattern, resolved when a
+    packet source/assembler is constructed so drills retune per run."""
+    return {
+        "host": os.environ.get("BLIT_PACKET_HOST", config.packet_host),
+        "port": int(os.environ.get(
+            "BLIT_PACKET_PORT", config.packet_port)),
+        "rcvbuf_bytes": int(os.environ.get(
+            "BLIT_PACKET_RCVBUF", config.packet_rcvbuf_bytes)),
+        "ntime": int(os.environ.get(
+            "BLIT_PACKET_NTIME", config.packet_ntime)),
+        "horizon_blocks": int(os.environ.get(
+            "BLIT_PACKET_HORIZON", config.packet_horizon_blocks)),
+    }
+
+
 def mesh_defaults(config: SiteConfig = DEFAULT) -> Dict:
     """The effective sharded-plane knob set (ISSUE 9): ``config``'s
     values with per-process ``BLIT_MESH_*`` environment overrides
@@ -486,6 +527,11 @@ def slo_defaults(config: SiteConfig = DEFAULT) -> List[Dict]:
     if floor is not None:
         objs.append({"name": "ingest-throughput", "kind": "throughput",
                      "metric": "ingest", "threshold": floor,
+                     "budget": config.slo_budget})
+    asm = opt_f("BLIT_SLO_PACKET_P99", config.slo_packet_assembly_p99_s)
+    if asm is not None:
+        objs.append({"name": "packet-assembly", "kind": "latency",
+                     "metric": "packet.assembly_s", "threshold": asm,
                      "budget": config.slo_budget})
     objs.extend(config.slo_objectives or [])
     return objs

@@ -40,7 +40,15 @@ module holds both halves:
   without touching the peer itself) — and ``peer.request`` — fired by
   a serving peer per handled ``/product`` request, keyed by the
   fingerprint, so ``kill``/``hang`` drills take a REAL peer process
-  down mid-replay (the ``blit chaos --fleet`` schedule).  Rules fire on exact hit
+  down mid-replay (the ``blit chaos --fleet`` schedule).  The recorder
+  packet front end (ISSUE 18) adds the ``packet.recv`` point — fired by
+  the :class:`blit.stream.packet.PacketAssembler` per received
+  datagram, keyed ``<path>#pkt<pktidx>`` — and the ``reorder`` mode:
+  the caller holds the packet back until ``amount`` later packets have
+  been processed (default 3), the wire-level reordering a switch under
+  load produces (``blit chaos --fault reorder``); ``drop``/``dup``
+  apply there too, exercising gap masking and duplicate-tile
+  accounting end to end.  Rules fire on exact hit
   counts (``after``/``times``), so a test can target "window 3 of
   antenna 2" and get the same failure every run.  ``BLIT_FAULTS`` in
   the environment arms rules at import time for CLI-level drills (see
@@ -76,7 +84,7 @@ from typing import Callable, Dict, List, Optional
 log = logging.getLogger("blit.faults")
 
 MODES = ("fail", "delay", "truncate", "corrupt", "drop", "dup",
-         "kill", "hang")
+         "kill", "hang", "reorder")
 
 
 class InjectedFault(OSError):

@@ -1428,6 +1428,19 @@ def bench_metrics(doc: Dict) -> Dict[str, float]:
         v = num((doc.get("dedoppler") or {}).get("drift_rates_per_s"))
         if v is not None:
             out["dedoppler.drift_rates_per_s"] = v
+        # The live leg's latency tails (ISSUE 18: the --packets run is
+        # the sustained-capture gate) — *_pNN_s keys compare
+        # lower-is-better in bench_diff, like the serve quantiles.
+        live = doc.get("live") or {}
+        for k in ("chunk_to_product_p50_s", "chunk_to_product_p99_s"):
+            v = num(live.get(k))
+            if v is not None:
+                out[f"live.{k}"] = v
+        pk = live.get("packet") or {}
+        for k in ("assembly_p50_s", "assembly_p99_s"):
+            v = num(pk.get(k))
+            if v is not None:
+                out[f"packet.{k}"] = v
         return out
     metric = doc.get("metric")
     for k, v in doc.items():

@@ -821,6 +821,7 @@ class StreamSupervisor:
                  lateness_s: Optional[float] = None,
                  idle_timeout_s: Optional[float] = None,
                  done_path: Optional[str] = None,
+                 source: Optional[Dict] = None,
                  lease_ttl_s: Optional[float] = None,
                  poll_s: Optional[float] = None,
                  max_attempts: Optional[int] = None,
@@ -841,6 +842,10 @@ class StreamSupervisor:
         self.lateness_s = lateness_s
         self.idle_timeout_s = idle_timeout_s
         self.done_path = done_path
+        # A source SPEC (blit.stream.session.source_from_spec) overrides
+        # the raw/replay_rate/tail knobs: the child rebuilds the seat's
+        # source — packet capture included — from this dict.
+        self.source = dict(source) if source else None
         d = recover_defaults(config)
         self.lease_ttl_s = (d["lease_ttl_s"] if lease_ttl_s is None
                             else float(lease_ttl_s))
@@ -935,7 +940,8 @@ class StreamSupervisor:
             search=self.search, replay_rate=self.replay_rate,
             lateness_s=self.lateness_s,
             idle_timeout_s=self.idle_timeout_s,
-            done_path=self.done_path, lease_dir=self.lease_dir,
+            done_path=self.done_path, source=self.source,
+            lease_dir=self.lease_dir,
             proc=0,
             result=os.path.join(self.lease_dir,
                                 f"a{attempt}s.result.json"),
@@ -1038,10 +1044,13 @@ def _child_scan(spec: Dict) -> Dict:
 
 def _child_stream(spec: Dict) -> Dict:
     from blit.stream import FileTailSource, ReplaySource
+    from blit.stream.session import source_from_spec
 
     lease = Lease(spec["lease_dir"], spec["proc"])
     lease.beat(-1)
-    if spec.get("replay_rate"):
+    if spec.get("source"):
+        src = source_from_spec(spec["source"])
+    elif spec.get("replay_rate"):
         src = ReplaySource(spec["raw"], rate=spec["replay_rate"])
     else:
         src = FileTailSource(
@@ -1055,17 +1064,21 @@ def _child_stream(spec: Dict) -> Dict:
         hdr = stream_search(
             src, spec["out_path"], resume=True, heartbeat=hb,
             lateness_s=spec.get("lateness_s"), **k, **spec["search"])
-        return {"out": spec["out_path"],
-                "windows": hdr.get("search_windows"),
-                "nhits": hdr.get("search_nhits"),
-                "masked": hdr.get("stream_masked_chunks")}
-    from blit.stream import stream_reduce
+        out = {"out": spec["out_path"],
+               "windows": hdr.get("search_windows"),
+               "nhits": hdr.get("search_nhits"),
+               "masked": hdr.get("stream_masked_chunks")}
+    else:
+        from blit.stream import stream_reduce
 
-    hdr = stream_reduce(
-        src, spec["out_path"], resume=True, heartbeat=hb,
-        lateness_s=spec.get("lateness_s"), **k)
-    return {"out": spec["out_path"], "nsamps": hdr.get("nsamps"),
-            "masked": hdr.get("stream_masked_chunks")}
+        hdr = stream_reduce(
+            src, spec["out_path"], resume=True, heartbeat=hb,
+            lateness_s=spec.get("lateness_s"), **k)
+        out = {"out": spec["out_path"], "nsamps": hdr.get("nsamps"),
+               "masked": hdr.get("stream_masked_chunks")}
+    if hasattr(src, "packet_report"):
+        out["packet"] = src.packet_report()
+    return out
 
 
 def _child_main(spec_path: str) -> int:

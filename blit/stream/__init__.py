@@ -15,7 +15,15 @@ The golden contract: streaming a fully-recorded file through
 """
 
 from blit.stream.cursor import StreamCursor
+from blit.stream.packet import (
+    PacketAssembler,
+    PacketFramer,
+    PacketReplaySource,
+    PacketSource,
+    packets_of,
+)
 from blit.stream.plane import LiveRawStream, stream_reduce, stream_search
+from blit.stream.session import SessionSupervisor, source_from_spec
 from blit.stream.source import (
     ChunkSource,
     FileTailSource,
@@ -29,11 +37,18 @@ __all__ = [
     "ChunkSource",
     "FileTailSource",
     "LiveRawStream",
+    "PacketAssembler",
+    "PacketFramer",
+    "PacketReplaySource",
+    "PacketSource",
     "QueueSource",
     "ReplaySource",
+    "SessionSupervisor",
     "StreamChunk",
     "StreamCursor",
     "chunks_of",
+    "packets_of",
+    "source_from_spec",
     "stream_reduce",
     "stream_search",
 ]

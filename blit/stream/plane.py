@@ -248,6 +248,18 @@ class LiveRawStream:
                 self._next += 1
                 yield c
                 continue
+            gapped = getattr(self.source, "gapped", None)
+            if gapped is not None and self._next in gapped:
+                # The packet assembler PROVED this seat is a gap (its
+                # block was abandoned past the reorder horizon — see
+                # blit/stream/packet.py): mask it now instead of
+                # waiting out the lateness budget.  Same zero-weight
+                # bytes as a watermark mask, lower latency — the
+                # assembler's evidence (packets far past the block)
+                # is strictly stronger than a timer.
+                self.timeline.count("stream.chunk.gap_fastpath")
+                yield self._mask_next(self._clock())
+                continue
             if (self._total is not None and self._next >= self._total
                     and not self._pending):
                 return

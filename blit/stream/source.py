@@ -39,6 +39,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from blit import observability
 from blit.io.guppi import (
     SEQ_RE,
     block_ntime,
@@ -206,19 +207,25 @@ class FileTailSource(ChunkSource):
     truncation (warned, skipped) — the ``GuppiRaw`` constructor's rule.
 
     End of session: the ``done_path`` marker file (default
-    ``<stem>.done``), or ``idle_timeout_s`` without file growth.
-    Delivery is strictly in-order, so the assembler's watermark never
-    masks behind this source — its job here is purely latency/liveness
-    accounting."""
+    ``<stem>.done``), or ``idle_timeout_s`` without file growth — the
+    timeout path flight-dumps once (a recorder that died without its
+    ``.done`` marker is an incident, not a clean end) and the current
+    idle age is published as the ``stream.tail.idle_s`` gauge, so a
+    silently dead recorder shows in ``blit top`` BEFORE the timeout
+    fires.  Delivery is strictly in-order, so the assembler's watermark
+    never masks behind this source — its job here is purely
+    latency/liveness accounting."""
 
     def __init__(self, path: str, poll_s: Optional[float] = None,
                  idle_timeout_s: Optional[float] = None,
                  done_path: Optional[str] = None,
                  follow_sequence: bool = True,
-                 clock=time.monotonic, sleep=time.sleep):
-        from blit.config import stream_defaults
+                 timeline=None,
+                 clock=time.monotonic, sleep=time.sleep,
+                 config=None):
+        from blit.config import DEFAULT, stream_defaults
 
-        d = stream_defaults()
+        d = stream_defaults(DEFAULT if config is None else config)
         self.path = path
         self.poll_s = d["poll_s"] if poll_s is None else poll_s
         self.idle_timeout_s = (d["idle_timeout_s"] if idle_timeout_s is None
@@ -237,12 +244,21 @@ class FileTailSource(ChunkSource):
         self._last_size = -1
         self._last_growth = clock()
         self.total = None
+        self._timeline = timeline
 
     def _next_member(self) -> Optional[str]:
         if not self.follow_sequence:
             return None
         nxt = f"{self._stem}.{self._member + 1:04d}.raw"
         return nxt if os.path.exists(nxt) else None
+
+    def _gauge_idle(self, idle_s: float) -> None:
+        """Publish how long the tail has seen no growth — the liveness
+        signal ``blit top`` reads while the recorder runs (and the
+        early warning before ``idle_timeout_s`` ends the session)."""
+        if self._timeline is None:
+            self._timeline = observability.process_timeline()
+        self._timeline.gauge("stream.tail.idle_s", idle_s)
 
     def _try_block(self) -> Optional[StreamChunk]:
         """One complete block at the current offset, else None."""
@@ -322,12 +338,19 @@ class FileTailSource(ChunkSource):
                 self.total = self._seq
                 return None
             now = self._clock()
+            self._gauge_idle(now - self._last_growth)
             if (self.idle_timeout_s is not None
                     and now - self._last_growth > self.idle_timeout_s):
                 log.warning(
                     "%s: no growth for %.1fs and no done marker at %s; "
                     "ending the tail (recorder gone?)", self._cur,
                     now - self._last_growth, self.done_path)
+                observability.flight_recorder().dump(
+                    f"tail idle: {self._cur} grew nothing for "
+                    f"{now - self._last_growth:.1f}s with no done "
+                    f"marker at {self.done_path} — recorder presumed "
+                    "dead, ending the session at block "
+                    f"{self._seq}", force=True)
                 self.finished = True
                 self.total = self._seq
                 return None

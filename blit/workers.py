@@ -209,6 +209,7 @@ def stream_raw(
     lateness_s: Optional[float] = None,
     idle_timeout_s: Optional[float] = None,
     done_path: Optional[str] = None,
+    source: Optional[dict] = None,
     nfft: int = 1024,
     nint: int = 1,
     **reducer_kw,
@@ -222,6 +223,10 @@ def stream_raw(
 
     ``replay_rate`` switches to a paced replay of an at-rest recording
     (``blit.stream.ReplaySource`` — drills and the bench rig);
+    ``source`` is a source SPEC dict
+    (:func:`blit.stream.session.source_from_spec` — how a session
+    orchestrator hands a worker a packet-capture or packet-replay seat
+    over the wire, ISSUE 18) and overrides the tail/replay knobs;
     ``search=True`` writes a ``.hits`` drift-search product through
     :func:`blit.stream.stream_search` instead of a filterbank.  The
     watermark knobs left ``None`` resolve from SiteConfig +
@@ -230,19 +235,28 @@ def stream_raw(
     from blit.stream import (
         FileTailSource,
         ReplaySource,
+        source_from_spec,
         stream_reduce,
         stream_search,
     )
 
-    if replay_rate is not None:
+    reducer_kw.setdefault("timeline", process_timeline())
+    if source is not None:
+        spec = dict(source)
+        spec.setdefault("raw", raw_path)
+        src = source_from_spec(spec, timeline=reducer_kw["timeline"])
+    elif replay_rate is not None:
         src = ReplaySource(raw_path, rate=replay_rate)
     else:
         src = FileTailSource(raw_path, idle_timeout_s=idle_timeout_s,
                              done_path=done_path)
-    reducer_kw.setdefault("timeline", process_timeline())
     fn = stream_search if search else stream_reduce
-    return fn(src, out_path, lateness_s=lateness_s, nfft=nfft,
-              nint=nint, **reducer_kw)
+    hdr = fn(src, out_path, lateness_s=lateness_s, nfft=nfft,
+             nint=nint, **reducer_kw)
+    if hasattr(src, "packet_report"):
+        hdr = dict(hdr)
+        hdr["_packet_report"] = src.packet_report()
+    return hdr
 
 
 def search_raw(

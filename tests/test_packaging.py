@@ -105,7 +105,10 @@ class TestPublicSurface:
 
         expected = {
             "ChunkSource", "FileTailSource", "LiveRawStream",
-            "QueueSource", "ReplaySource", "StreamChunk", "chunks_of",
+            "PacketAssembler", "PacketFramer", "PacketReplaySource",
+            "PacketSource", "QueueSource", "ReplaySource",
+            "SessionSupervisor", "StreamChunk", "StreamCursor",
+            "chunks_of", "packets_of", "source_from_spec",
             "stream_reduce", "stream_search",
         }
         assert set(blit.stream.__all__) == expected
