@@ -509,18 +509,33 @@ def _cmd_fleet_peer(args: argparse.Namespace) -> int:
     import os
     import threading
 
+    from blit.config import DEFAULT
     from blit.observability import Timeline
     from blit.serve import ProductCache, ProductService, Scheduler
     from blit.serve.http import PeerServer, install_drain_handler
 
     tl = Timeline()
+    # Archive plane (ISSUE 19): --catalog-root arms the peer's catalog
+    # (kind="catalog" asks + local session=/scan= resolution);
+    # --cold-dir/--disk-bytes arm the tiered store behind the hot disk
+    # cache.  Flags override the env/config defaults.
+    config = DEFAULT
+    if args.catalog_root:
+        config = config.with_(catalog_root=args.catalog_root)
+    if args.cold_dir:
+        config = config.with_(cache_cold_dir=args.cold_dir)
+    from blit.config import archive_defaults
+
     service = ProductService(
         cache=ProductCache(args.cache_dir, ram_bytes=args.ram_bytes,
+                           disk_bytes=args.disk_bytes,
+                           cold_dir=archive_defaults(config)["cold_dir"],
                            timeline=tl),
         scheduler=Scheduler(max_concurrency=args.concurrency,
                             queue_depth=args.queue_depth, timeline=tl,
                             retry_seed=args.retry_seed),
         timeline=tl,
+        config=config,
     )
     server = PeerServer(service, name=args.name, port=args.port,
                         host=args.host,
@@ -561,7 +576,10 @@ def _spawn_fleet_peers(td: str, npeers: int, *, concurrency: int,
                        beat_interval_s: float = 0.2,
                        bringup_timeout_s: float = 120.0,
                        standbys: int = 0,
-                       extra_env: Optional[dict] = None):
+                       extra_env: Optional[dict] = None,
+                       catalog_root: Optional[str] = None,
+                       cold_dirs: bool = False,
+                       disk_bytes: Optional[int] = None):
     """Bring up ``npeers`` REAL ``blit fleet-peer`` subprocesses (the
     bench/chaos rig): per-peer cache dirs + one shared lease dir under
     ``td``, ephemeral ports published through port files.  Returns
@@ -572,7 +590,12 @@ def _spawn_fleet_peers(td: str, npeers: int, *, concurrency: int,
     ``standbys`` additionally spawns that many ``--standby`` peers
     (ISSUE 17): named ``standby{j}``, lease proc ``npeers + j``,
     appended to both ``procs`` and ``peers`` — the caller registers
-    them via ``door.add_standby`` instead of the ring-seeding map."""
+    them via ``door.add_standby`` instead of the ring-seeding map.
+
+    Archive plane (ISSUE 19): ``catalog_root`` arms every peer's
+    catalog, ``cold_dirs`` gives each peer a per-peer cold tier under
+    ``td``, ``disk_bytes`` caps the hot disk tier (what forces
+    demotion)."""
     import os
     import subprocess
     import time as _time
@@ -595,6 +618,12 @@ def _spawn_fleet_peers(td: str, npeers: int, *, concurrency: int,
                "--ram-bytes", str(ram_bytes),
                "--beat-interval", str(beat_interval_s),
                "--retry-seed", str(i)]
+        if catalog_root:
+            cmd += ["--catalog-root", catalog_root]
+        if cold_dirs:
+            cmd += ["--cold-dir", os.path.join(td, f"cold-{name}")]
+        if disk_bytes is not None:
+            cmd += ["--disk-bytes", str(disk_bytes)]
         if i >= npeers:
             cmd.append("--standby")
         env = dict(os.environ)
@@ -1450,15 +1479,21 @@ def _serve_bench_diurnal(args: argparse.Namespace) -> int:
 
 
 def _serve_bench_archive_day(args: argparse.Namespace) -> int:
-    """``serve-bench --archive-day`` (ISSUE 16 tentpole #4): replay a
-    zipfian MULTI-SESSION observing day over REAL ``fleet-peer``
-    subprocesses, once per wire — binary then legacy JSON, identical
-    seeds, fresh peer caches each pass — and report what the hot-path
-    data plane is worth: per-tier hit rate (RAM / disk / encoded-wire),
-    wire GB/s off the door's byte histogram, serialize / deserialize
-    p50/p99, and the binary-vs-JSON A/B with a byte-identity pin.  The
-    record carries ``config.backend`` (the rig) and a flat ``metrics``
-    dict so ``blit bench-diff`` extracts and gates it exactly like the
+    """``serve-bench --archive-day`` (ISSUE 16 tentpole #4, extended
+    into the ISSUE 19 archive-plane proof): replay a zipfian
+    MULTI-SESSION observing day at accelerated clock over REAL
+    ``fleet-peer`` subprocesses serving a REAL on-disk archive tree.
+    Every product ask is by-(session, scan, player) and resolves
+    through the door's catalog, peers run hot(+cold) tiered caches
+    with a bounded hot disk (what forces demotion), and
+    ``kind="catalog"`` asks ride the same wire.  Two passes per run —
+    binary then legacy JSON, identical seeds, fresh peer caches — and
+    the report carries catalog-lookup p50/p99, per-tier
+    (ram/wire/disk/cold/derive) rates, SLO attainment against
+    ``--slo-ms``, the wire A/B with a byte-identity pin AND the
+    addressed-vs-explicit-member byte-identity pin.  The record
+    carries ``config.backend`` (the rig) and a flat ``metrics`` dict
+    so ``blit bench-diff`` extracts and gates it exactly like the
     ingest records."""
     import math
     import os
@@ -1474,7 +1509,7 @@ def _serve_bench_archive_day(args: argparse.Namespace) -> int:
     from blit.serve.fleet import FleetFrontDoor
     from blit.serve.http import http_json, install_drain_handler
     from blit.serve.scheduler import DeadlineExpired
-    from blit.testing import synth_raw
+    from blit.testing import build_observation_tree
 
     try:
         import jax
@@ -1487,24 +1522,37 @@ def _serve_bench_archive_day(args: argparse.Namespace) -> int:
     def q(h, p: float) -> float:
         return round(h.percentile(p), 6) if h is not None and h.n else 0.0
 
+    players = ((0, 0), (0, 1))
+    slo_s = args.slo_ms / 1e3
     with tempfile.TemporaryDirectory(prefix="blit-archive-day-") as td:
-        # The day's archive: --sessions observing sessions, each with
-        # --distinct products over its own recordings.  Popularity is
-        # zipfian along BOTH axes — a few hot sessions dominate the day
-        # and within a session a few hot products dominate — which is
-        # what makes the encoded-wire cache tier earn its bytes.
-        ntime = (8 + 3) * args.nfft  # 8 PFB frames at ntap=4
-        reqs, weights = [], []
-        for s in range(args.sessions):
-            for i in range(args.distinct):
-                path = os.path.join(td, f"day-s{s:02d}p{i:03d}.raw")
-                synth_raw(path, nblocks=1, obsnchan=2,
-                          ntime_per_block=ntime,
-                          seed=s * args.distinct + i)
-                reqs.append(ProductRequest(raw=path, nfft=args.nfft,
-                                           nint=1))
-                weights.append(1.0 / (math.pow(s + 1, args.zipf_s)
-                                      * math.pow(i + 1, args.zipf_s)))
+        # The day's archive is a REAL on-disk BL tree (ISSUE 19):
+        # --sessions observing sessions x --distinct scans x the
+        # player pair, crawled by every peer's catalog AND the
+        # door's.  Popularity is zipfian along BOTH axes — a few hot
+        # sessions dominate the day and within a session a few hot
+        # scans dominate — which is what makes the warm tiers earn
+        # their bytes.
+        arc = os.path.join(td, "archive")
+        raw_ntime = 6 * args.nfft  # x2 blocks/file = 12 frames' worth
+        scan_names = [f"{i + 1:04d}" for i in range(args.distinct)]
+        sess_names = [f"AGBT25A_999_{s:02d}"
+                      for s in range(args.sessions)]
+        for sess in sess_names:
+            build_observation_tree(
+                arc, sess, scans=tuple(scan_names), players=players,
+                kind="raw", nchans=2, raw_ntime=raw_ntime, nfiles=1)
+        reqs = []   # (addressed request, session, scan)
+        weights = []
+        for s, sess in enumerate(sess_names):
+            for i, scan in enumerate(scan_names):
+                w = 1.0 / (math.pow(s + 1, args.zipf_s)
+                           * math.pow(i + 1, args.zipf_s))
+                for band, bank in players:
+                    reqs.append((ProductRequest(
+                        raw="", session=sess, scan=scan, band=band,
+                        bank=bank, nfft=args.nfft, nint=1),
+                        sess, scan))
+                    weights.append(w)
         picks = random.Random(args.seed).choices(
             range(len(reqs)), weights=weights, k=args.requests)
 
@@ -1522,13 +1570,14 @@ def _serve_bench_archive_day(args: argparse.Namespace) -> int:
             pinned = {"BLIT_FLEET_WIRE": wire_mode,
                       "BLIT_FLEET_WIRE_DEFLATE": "1" if args.deflate
                       else "0",
-                      "BLIT_REQUEST_LOG": ""}
+                      "BLIT_REQUEST_LOG": args.request_log or ""}
             prev = {k: os.environ.get(k) for k in pinned}
             os.environ.update(pinned)
             procs, peers, lease_dir = _spawn_fleet_peers(
                 pd, args.peers, concurrency=args.concurrency,
                 queue_depth=args.queue_depth, ram_bytes=args.ram_bytes,
-                extra_env=pinned)
+                extra_env=pinned, catalog_root=arc, cold_dirs=True,
+                disk_bytes=args.disk_bytes)
             try:
                 door = FleetFrontDoor(
                     peers, lease_dir=lease_dir, timeline=tl,
@@ -1536,7 +1585,9 @@ def _serve_bench_archive_day(args: argparse.Namespace) -> int:
                     poll_s=min(0.1, args.peer_ttl / 4),
                     hedge_floor_s=args.hedge_floor_ms / 1e3,
                     request_timeout_s=60.0,
-                    config=DEFAULT.with_(fleet_wire=wire_mode)).start()
+                    config=DEFAULT.with_(
+                        fleet_wire=wire_mode, catalog_root=arc,
+                        request_log_dir=args.request_log)).start()
             finally:
                 for k, v in prev.items():
                     if v is None:
@@ -1548,18 +1599,41 @@ def _serve_bench_archive_day(args: argparse.Namespace) -> int:
             lock = threading.Lock()
             rejected = [0]
             delivered = [0]  # decoded product bytes handed to clients
+            slo_ok = [0]
+            nprod = [0]
+            catalog_asks = [0]
             errors: list = []
-            it = iter(picks)
+            it = iter(enumerate(picks))
 
             def client_loop(cid: int) -> None:
                 while True:
                     with lock:
-                        k = next(it, None)
-                    if k is None:
+                        nk = next(it, None)
+                    if nk is None:
                         return
+                    n, k = nk
+                    req, sess, scan = reqs[k]
+                    if n % 16 == 0:
+                        # Every 16th slot also asks the CATALOG about
+                        # the scan it is about to fetch — the
+                        # archive-plane control queries ride the same
+                        # wire and feed the same catalog.lookup_s
+                        # histogram as door-side resolution.
+                        try:
+                            door.get(ProductRequest(
+                                kind="catalog",
+                                raw=f"{sess}/{scan}"),
+                                client=f"client{cid}")
+                            with lock:
+                                catalog_asks[0] += 1
+                        except Exception as e:  # noqa: BLE001
+                            with lock:
+                                errors.append(f"catalog: {e!r}")
                     t = _time.perf_counter()
+                    ok = False
                     try:
-                        _, d = door.get(reqs[k], client=f"client{cid}")
+                        _, d = door.get(req, client=f"client{cid}")
+                        ok = True
                         with lock:
                             delivered[0] += d.nbytes
                     except (Overloaded, DeadlineExpired) as e:
@@ -1570,7 +1644,12 @@ def _serve_bench_archive_day(args: argparse.Namespace) -> int:
                     except Exception as e:  # noqa: BLE001 — reported
                         with lock:
                             errors.append(repr(e))
-                    lat.observe(_time.perf_counter() - t)
+                    dur = _time.perf_counter() - t
+                    lat.observe(dur)
+                    with lock:
+                        nprod[0] += 1
+                        if ok and dur <= slo_s:
+                            slo_ok[0] += 1
 
             try:
                 t0 = _time.perf_counter()
@@ -1582,17 +1661,32 @@ def _serve_bench_archive_day(args: argparse.Namespace) -> int:
                 for t in threads:
                     t.join()
                 wall = _time.perf_counter() - t0
-                # The byte-identity probe: the day's hottest product,
-                # decoded through THIS pass's wire.
+                # Byte-identity probes on the day's hottest product:
+                # (1) decoded through THIS pass's wire for the
+                # cross-wire pin, (2) addressed-by-(session, scan,
+                # player) vs explicit member paths — the ISSUE 19
+                # catalog-resolution acceptance.
                 probe = None
+                addr_identical = None
                 try:
-                    ph, pdata = door.get(reqs[0], client="probe")
+                    req0, sess0, scan0 = reqs[0]
+                    ph, pdata = door.get(req0, client="probe")
                     probe = (dict(ph), pdata.dtype.str,
                              tuple(pdata.shape), pdata.tobytes())
+                    members = door.catalog.resolve(
+                        sess0, scan0, band=req0.band, bank=req0.bank)
+                    _, edata = door.get(
+                        ProductRequest(raw=tuple(members),
+                                       nfft=args.nfft, nint=1),
+                        client="probe-explicit")
+                    addr_identical = (
+                        pdata.dtype == edata.dtype
+                        and pdata.shape == edata.shape
+                        and pdata.tobytes() == edata.tobytes())
                 except Exception as e:  # noqa: BLE001 — reported
                     errors.append(f"probe: {e!r}")
                 tiers = {"hit.ram": 0, "hit.disk": 0, "hit.wire": 0,
-                         "miss": 0}
+                         "hit.cold": 0, "derive": 0, "miss": 0}
                 for _name, url in sorted(peers.items()):
                     try:
                         _, _, s = http_json("GET", url, "/stats",
@@ -1611,8 +1705,10 @@ def _serve_bench_archive_day(args: argparse.Namespace) -> int:
                 de = tl.hists.get("fleet.deserialize_s")
                 wire_h = tl.hists.get("fleet.wire_bytes")
                 wire_bytes = float(wire_h.total) if wire_h else 0.0
-                served = tiers["hit.ram"] + tiers["hit.disk"]
+                served = (tiers["hit.ram"] + tiers["hit.disk"]
+                          + tiers["hit.cold"])
                 total = served + tiers["miss"]
+                ch = tl.hists.get("catalog.lookup_s")
                 c = door.stats()["counters"]
                 rep = {
                     "wire": wire_mode,
@@ -1637,6 +1733,12 @@ def _serve_bench_archive_day(args: argparse.Namespace) -> int:
                     "serialize_p99_s": q(ser, 0.99),
                     "deserialize_p50_s": q(de, 0.50),
                     "deserialize_p99_s": q(de, 0.99),
+                    "catalog_lookup_p50_s": q(ch, 0.50),
+                    "catalog_lookup_p99_s": q(ch, 0.99),
+                    "catalog_asks": catalog_asks[0],
+                    "slo_attained": (round(slo_ok[0] / nprod[0], 4)
+                                     if nprod[0] else 0.0),
+                    "addressing_byte_identical": addr_identical,
                     "door": {
                         "binary_responses": c.get("fleet.wire.binary",
                                                   0),
@@ -1658,25 +1760,47 @@ def _serve_bench_archive_day(args: argparse.Namespace) -> int:
         json_rep, json_probe = one_pass("json", "legacy")
         byte_identical = (bin_probe is not None
                           and bin_probe == json_probe)
+        addressing_ok = (bin_rep["addressing_byte_identical"] is True
+                         and json_rep["addressing_byte_identical"]
+                         is True)
         speedup = (json_rep["wall_s"] / bin_rep["wall_s"]
                    if bin_rep["wall_s"] else 0.0)
+        # The accelerated-clock framing: the replay IS the day's
+        # zipfian ask stream compressed into wall_s, so the modeled
+        # archive-day request count is requests x (86400 / wall_s) —
+        # the number the catalog/tier quantiles were measured under.
+        accel = (86400.0 / bin_rep["wall_s"] if bin_rep["wall_s"]
+                 else 0.0)
+        bt = bin_rep["tiers"]
+        t_total = (bt["hit.ram"] + bt["hit.disk"] + bt["hit.wire"]
+                   + bt["hit.cold"] + bt["miss"])
+
+        def tier_rate(k: str) -> float:
+            return round(bt[k] / t_total, 4) if t_total else 0.0
+
         report = {
             "serve_bench": "archive-day",
             "requests": args.requests,
             "sessions": args.sessions,
-            "distinct": args.sessions * args.distinct,
+            "scans_per_session": args.distinct,
+            "distinct": args.sessions * args.distinct * len(players),
             "clients": args.clients,
             "peers": args.peers,
             "replicas": args.replicas,
             "zipf_s": args.zipf_s,
             "seed": args.seed,
+            "slo_ms": args.slo_ms,
+            "clock_accel": round(accel, 1),
+            "modeled_day_requests": int(args.requests * accel),
             "config": {"backend": backend, "nfft": args.nfft,
                        "peers": args.peers,
-                       "deflate": bool(args.deflate)},
+                       "deflate": bool(args.deflate),
+                       "disk_bytes": args.disk_bytes},
             "binary": bin_rep,
             "legacy_json": json_rep,
             "ab": {
                 "byte_identical": byte_identical,
+                "addressing_byte_identical": addressing_ok,
                 "wire_speedup": round(speedup, 4),
                 "binary_wall_s": bin_rep["wall_s"],
                 "json_wall_s": json_rep["wall_s"],
@@ -1684,8 +1808,10 @@ def _serve_bench_archive_day(args: argparse.Namespace) -> int:
                 "json_wire_gbps": json_rep["wire_gbps"],
             },
             # The flat gate surface: bench-diff reads exactly these
-            # (throughput/hit-rate band up, latency-quantile band
-            # inverted).
+            # (throughput/hit-rate/attainment band up,
+            # latency-quantile band inverted).  tier_derive_rate is
+            # report-only — a RISING derive rate is a regression, so
+            # it must not ride the higher-is-better extractor.
             "metrics": {
                 "fleet_hit_rate": bin_rep["hit_rate"],
                 "fleet_wire_gbps": bin_rep["wire_gbps"],
@@ -1695,9 +1821,26 @@ def _serve_bench_archive_day(args: argparse.Namespace) -> int:
                 "fleet_serialize_p99_s": bin_rep["serialize_p99_s"],
                 "fleet_deserialize_p99_s":
                     bin_rep["deserialize_p99_s"],
+                "catalog_lookup_p50_s":
+                    bin_rep["catalog_lookup_p50_s"],
+                "catalog_lookup_p99_s":
+                    bin_rep["catalog_lookup_p99_s"],
+                "tier_ram_hit_rate": tier_rate("hit.ram"),
+                "tier_disk_hit_rate": tier_rate("hit.disk"),
+                "tier_wire_hit_rate": tier_rate("hit.wire"),
+                "tier_cold_hit_rate": tier_rate("hit.cold"),
+                "tier_derive_rate": (round(bt["derive"] / t_total, 4)
+                                     if t_total else 0.0),
+                "slo_attained": bin_rep["slo_attained"],
             },
             "errors": (bin_rep["errors"] + json_rep["errors"])[:5],
         }
+        if args.request_log:
+            # The archive access log: door records carry the LOGICAL
+            # (session, scan) address, so `blit requests --aggregate`
+            # groups a day's traffic per scan (ISSUE 19 satellite).
+            recs = monitor.read_requests(args.request_log)
+            report["request_log"] = monitor.aggregate_requests(recs)
         out = json.dumps(report)
         print(out)
         if args.out:
@@ -1707,7 +1850,7 @@ def _serve_bench_archive_day(args: argparse.Namespace) -> int:
             os.replace(tmp, args.out)
     if report["errors"]:
         return 1
-    return 0 if byte_identical else 1
+    return 0 if (byte_identical and addressing_ok) else 1
 
 
 def _monitor_from_flags(args: argparse.Namespace):
@@ -2207,12 +2350,192 @@ def _cmd_fsck(args: argparse.Namespace) -> int:
 
     rep = integrity.fsck(args.root, repair=args.repair,
                          quarantine=not args.no_quarantine)
+    cold = getattr(args, "cold_dir", None)
+    if cold:
+        # The cold tier (ISSUE 19) shares the hot tier's sidecar
+        # convention, so the SAME walk verifies/quarantines/repairs it
+        # — one merged report, one exit verdict.
+        crep = integrity.fsck(cold, repair=args.repair,
+                              quarantine=not args.no_quarantine)
+        rep = {
+            "root": rep["root"], "cold_root": crep["root"],
+            "checked": rep["checked"] + crep["checked"],
+            "ok": rep["ok"] + crep["ok"],
+            "unmanifested": (rep["unmanifested"]
+                             + crep["unmanifested"]),
+            "in_progress": rep["in_progress"] + crep["in_progress"],
+            "bad": rep["bad"] + crep["bad"],
+            "quarantined": rep["quarantined"] + crep["quarantined"],
+            "repaired": rep["repaired"] + crep["repaired"],
+            "repair_failed": (rep["repair_failed"]
+                              + crep["repair_failed"]),
+            "clean": rep["clean"] and crep["clean"],
+        }
     body = json.dumps(rep)
     print(body)
     if args.json_out:
         with open(args.json_out, "w") as f:
             f.write(body)
     return 0 if rep["clean"] else 1
+
+
+def _cmd_backfill(args: argparse.Namespace) -> int:
+    """``blit backfill`` (ISSUE 19 tentpole #3): walk an archive root
+    through the catalog crawl, derive + publish EVERY (session, scan,
+    player) product into a hot(+cold) cache — the fleet then serves the
+    archive day from warm tiers instead of recompute storms.
+
+    Resumable by construction: a product's completion is recorded in an
+    append-only fsync-per-line LEDGER only AFTER its cache publish
+    lands, so a kill mid-derive leaves no entry and the product simply
+    re-derives on resume, while completed products are never re-derived
+    (the acceptance kill-drill).  Products are content-addressed, so an
+    interrupted+resumed backfill finishes byte-identical to an
+    uninterrupted one.
+
+    Paced like the PR-12 Scrubber: after each product the walker sleeps
+    off the debt ``max(0, input_bytes / bytes_per_s - elapsed)`` so a
+    backfill sharing a host with foreground serving never starves it."""
+    import os
+    import time as _time
+
+    from blit.config import DEFAULT, archive_defaults
+    from blit.observability import Timeline
+    from blit.serve.cache import ProductCache, fingerprint_for
+    from blit.serve.catalog import CatalogIndex
+    from blit.serve.service import ProductRequest
+
+    config = DEFAULT
+    if args.cold_dir:
+        config = config.with_(cache_cold_dir=args.cold_dir)
+    if args.bytes_per_s is not None:
+        config = config.with_(backfill_bytes_per_s=args.bytes_per_s
+                              if args.bytes_per_s > 0 else None)
+    bps = archive_defaults(config)["backfill_bytes_per_s"]
+    tl = Timeline()
+    cache = ProductCache(args.cache_dir, ram_bytes=args.ram_bytes,
+                         disk_bytes=args.disk_bytes,
+                         cold_dir=archive_defaults(config)["cold_dir"],
+                         timeline=tl)
+    catalog = CatalogIndex(args.root, config=config, rescan_s=0.0,
+                           timeline=tl)
+    catalog.refresh(force=True)
+    ledger_path = args.ledger or os.path.join(args.cache_dir,
+                                              "backfill.ledger.jsonl")
+    done: set = set()
+    if os.path.exists(ledger_path):
+        with open(ledger_path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    done.add(json.loads(line)["fp"])
+                except (ValueError, KeyError):
+                    # A torn tail line (the crash wrote half a record):
+                    # treat as not-completed — the product re-derives.
+                    continue
+    os.makedirs(os.path.dirname(os.path.abspath(ledger_path)),
+                exist_ok=True)
+    ledger = open(ledger_path, "a")
+    if ledger.tell() > 0:
+        with open(ledger_path, "rb") as f:
+            f.seek(-1, os.SEEK_END)
+            torn_tail = f.read(1) != b"\n"
+        if torn_tail:
+            # Terminate the crash's half-record so the claims appended
+            # below never concatenate onto it (both would be lost on
+            # the NEXT resume).
+            ledger.write("\n")
+            ledger.flush()
+
+    def _claim(fp: str, session: str, scan: str, player: str) -> None:
+        """fsync-before-claim: the ledger line is durable BEFORE the
+        product counts as completed — a crash can lose work, never
+        fake it."""
+        ledger.write(json.dumps({"fp": fp, "session": session,
+                                 "scan": scan, "player": player,
+                                 "t": round(_time.time(), 3)}) + "\n")
+        ledger.flush()
+        os.fsync(ledger.fileno())
+        done.add(fp)
+
+    report = {"backfill": True, "root": os.path.abspath(args.root),
+              "cache_dir": args.cache_dir,
+              "cold_dir": archive_defaults(config)["cold_dir"],
+              "ledger": ledger_path, "bytes_per_s": bps,
+              "products_total": 0, "derived": 0, "skipped_ledger": 0,
+              "skipped_cached": 0, "errors": []}
+    t_start = _time.perf_counter()
+    bytes_read = 0
+    debt_s = 0.0
+    stop = False
+    try:
+        with catalog._lock:
+            sessions = {s: dict(e["scans"])
+                        for s, e in catalog._sessions.items()}
+        for session in sorted(sessions):
+            if stop:
+                break
+            for scan in sorted(sessions[session]):
+                if stop:
+                    break
+                seqs = sessions[session][scan]["sequences"]
+                for (band, bank), members in sorted(seqs.items()):
+                    if args.limit and report["products_total"] >= args.limit:
+                        stop = True
+                        break
+                    report["products_total"] += 1
+                    player = f"BLP{band}{bank}"
+                    req = (ProductRequest(raw=tuple(members),
+                                          product=args.product)
+                           if args.product else
+                           ProductRequest(raw=tuple(members),
+                                          nfft=args.nfft,
+                                          nint=args.nint))
+                    reducer = req.reducer()
+                    fp = fingerprint_for(reducer, req.raw_source)
+                    if fp in done:
+                        report["skipped_ledger"] += 1
+                        continue
+                    if cache.contains(fp):
+                        # Published but the claim never landed (killed
+                        # in the publish→claim window) — or a foreground
+                        # serve beat us to it.  Completed either way.
+                        _claim(fp, session, scan, player)
+                        report["skipped_cached"] += 1
+                        continue
+                    t0 = _time.perf_counter()
+                    nbytes = sum(os.path.getsize(m) for m in members)
+                    try:
+                        header, data = reducer.reduce(req.raw_source)
+                        cache.put(fp, header, data, recipe=req.recipe())
+                        cache.note_derive()
+                    except Exception as e:  # noqa: BLE001 — reported
+                        report["errors"].append(
+                            f"{session}/{scan}/{player}: {e!r}")
+                        continue
+                    _claim(fp, session, scan, player)
+                    report["derived"] += 1
+                    bytes_read += nbytes
+                    # The Scrubber debt discipline: pay for the bytes
+                    # just read before touching the next product.
+                    if bps:
+                        dt = _time.perf_counter() - t0
+                        debt_s = max(0.0, nbytes / bps - dt)
+                        if debt_s > 0:
+                            _time.sleep(debt_s)
+    finally:
+        ledger.close()
+    report["wall_s"] = round(_time.perf_counter() - t_start, 3)
+    report["bytes_read"] = bytes_read
+    report["cache"] = cache.stats()
+    body = json.dumps(report)
+    print(body)
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            f.write(body)
+    return 1 if report["errors"] else 0
 
 
 def _chaos_corrupt(args: argparse.Namespace, work: str,
@@ -3652,6 +3975,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="bounded per-priority queue depth")
     pb.add_argument("--ram-bytes", type=int, default=64 << 20,
                     help="RAM cache tier byte budget")
+    pb.add_argument("--disk-bytes", type=int, default=None,
+                    help="per-peer HOT disk tier capacity "
+                         "(--archive-day; a bound forces demotion "
+                         "into each peer's cold tier)")
     pb.add_argument("--nfft", type=int, default=256)
     pb.add_argument("--seed", type=int, default=0)
     pb.add_argument("--disk-cache", action="store_true",
@@ -3767,6 +4094,17 @@ def main(argv: Optional[List[str]] = None) -> int:
                      help="lease heartbeat cadence (keep well under "
                           "the fleet's peer TTL)")
     pfp.add_argument("--drain-timeout", type=float, default=30.0)
+    pfp.add_argument("--catalog-root", default=None,
+                     help="archive tree to catalog (ISSUE 19): serves "
+                          "kind='catalog' asks and resolves "
+                          "session=/scan= logical addressing locally")
+    pfp.add_argument("--cold-dir", default=None,
+                     help="cold storage tier root (ISSUE 19): disk "
+                          "evictees demote here; cold hits are "
+                          "CRC-verified and promoted back")
+    pfp.add_argument("--disk-bytes", type=int, default=None,
+                     help="hot disk tier capacity (None = unbounded; "
+                          "a bound is what forces demotion)")
     pfp.add_argument("--standby", action="store_true",
                      help="run as an elastic STANDBY (ISSUE 17): "
                           "process up and lease beating but NOT in the "
@@ -3888,10 +4226,50 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="report only; leave corrupt artifacts in "
                          "place (default: move them to a .quarantine/ "
                          "sibling so they stop being served/resumed)")
+    pk.add_argument("--cold-dir", default=None,
+                    help="ALSO walk this cold storage tier (ISSUE 19): "
+                         "cold entries share the hot tier's sidecar "
+                         "convention, so quarantine and --repair "
+                         "re-derivation apply unchanged")
     pk.add_argument("--json-out", default=None,
                     help="also write the fsck report JSON here "
                          "(the CI drill artifact)")
     pk.set_defaults(fn=_cmd_fsck)
+
+    pbf = sub.add_parser(
+        "backfill",
+        help="derive+publish every product of an archive root into a "
+             "hot(+cold) cache — resumable (fsync-per-line ledger), "
+             "budget-paced (ISSUE 19)",
+    )
+    pbf.add_argument("root", help="archive tree to walk (the catalog "
+                                  "crawl's session/GUPPI layout)")
+    pbf.add_argument("--cache-dir", required=True,
+                     help="hot disk cache tier to publish into")
+    pbf.add_argument("--cold-dir", default=None,
+                     help="cold tier behind the hot cache (evictees "
+                          "demote here)")
+    pbf.add_argument("--ledger", default=None,
+                     help="completion ledger path (default: "
+                          "<cache-dir>/backfill.ledger.jsonl)")
+    pbf.add_argument("--product", default=None,
+                     help="rawspec preset (0000/0001/0002); otherwise "
+                          "--nfft/--nint configure the reduction")
+    pbf.add_argument("--nfft", type=int, default=1024)
+    pbf.add_argument("--nint", type=int, default=1)
+    pbf.add_argument("--ram-bytes", type=int, default=64 << 20)
+    pbf.add_argument("--disk-bytes", type=int, default=None,
+                     help="hot tier capacity (a bound forces demotion "
+                          "into --cold-dir)")
+    pbf.add_argument("--bytes-per-s", type=float, default=None,
+                     help="pacing budget over input bytes (the "
+                          "Scrubber debt discipline; 0 = unpaced; "
+                          "default SiteConfig.backfill_bytes_per_s)")
+    pbf.add_argument("--limit", type=int, default=None,
+                     help="stop after this many products (CI drills)")
+    pbf.add_argument("--json-out", default=None,
+                     help="also write the backfill report JSON here")
+    pbf.set_defaults(fn=_cmd_backfill)
 
     pt = sub.add_parser(
         "telemetry",

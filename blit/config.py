@@ -316,6 +316,32 @@ class SiteConfig:
     request_log_max_bytes: int = 8 << 20
     request_log_files: int = 4
     exemplars: bool = True
+    # Archive plane (blit/serve/catalog.py + the cold cache tier;
+    # ISSUE 19).  catalog_root, when set, enables the session/scan/
+    # product catalog: an in-RAM index over the inventory crawl, held
+    # by peers (served as ProductRequest(kind="catalog")) and by the
+    # fleet front door (which resolves by-(session, scan) asks into
+    # explicit member-path recipes BEFORE ring routing, so logical and
+    # explicit asks dedupe onto the same owner).  catalog_rescan_s
+    # bounds how often a lookup may re-stat the tree for the
+    # mtime-invalidated incremental rescan; catalog_negative_ttl_s /
+    # catalog_negative_max bound the negative-lookup cache so repeated
+    # misses cannot hammer the crawl.  cache_cold_dir enables the COLD
+    # storage tier behind the hot disk tier: content-addressed
+    # (sharded by fingerprint prefix), filled by demotion of hot-tier
+    # evictees, promoted back on hit under the PR-12 CRC manifest
+    # rules.  backfill_bytes_per_s paces `blit backfill` derivations
+    # (the Scrubber debt discipline) so a backfill never starves
+    # foreground serving.  Per-process overrides: BLIT_CATALOG_ROOT /
+    # BLIT_CATALOG_RESCAN / BLIT_CATALOG_NEG_TTL / BLIT_CATALOG_NEG_MAX
+    # / BLIT_CACHE_COLD_DIR / BLIT_BACKFILL_BYTES_PER_S
+    # (:func:`catalog_defaults` / :func:`archive_defaults`).
+    catalog_root: Optional[str] = None
+    catalog_rescan_s: float = 2.0
+    catalog_negative_ttl_s: float = 30.0
+    catalog_negative_max: int = 4096
+    cache_cold_dir: Optional[str] = None
+    backfill_bytes_per_s: float = 256e6
 
     def io_retry_policy(self):
         """The :class:`blit.faults.RetryPolicy` for worker-side file I/O —
@@ -660,6 +686,47 @@ def request_log_defaults(config: SiteConfig = DEFAULT) -> Dict:
         "exemplars": (config.exemplars if ex is None
                       else ex.lower() not in ("", "0", "false", "off")),
     }
+
+
+def catalog_defaults(config: SiteConfig = DEFAULT) -> Dict:
+    """The effective archive-catalog knob set (ISSUE 19): ``config``'s
+    values with per-process ``BLIT_CATALOG_*`` environment overrides
+    applied — the :func:`stream_defaults` pattern, resolved when a
+    :class:`blit.serve.catalog.CatalogIndex` is constructed so peers,
+    the front door and drills retune per run.  ``enabled`` is derived:
+    the catalog is on only when a root is configured."""
+    root = os.environ.get("BLIT_CATALOG_ROOT")
+    if root is None:
+        root = config.catalog_root
+    elif not root:
+        root = None
+    return {
+        "root": root,
+        "rescan_s": float(os.environ.get(
+            "BLIT_CATALOG_RESCAN", config.catalog_rescan_s)),
+        "negative_ttl_s": float(os.environ.get(
+            "BLIT_CATALOG_NEG_TTL", config.catalog_negative_ttl_s)),
+        "negative_max": int(os.environ.get(
+            "BLIT_CATALOG_NEG_MAX", config.catalog_negative_max)),
+        "enabled": root is not None,
+    }
+
+
+def archive_defaults(config: SiteConfig = DEFAULT) -> Dict:
+    """The effective archive-storage knob set (ISSUE 19): the cold
+    cache tier's root and the backfill pacing budget, with per-process
+    ``BLIT_CACHE_COLD_DIR`` / ``BLIT_BACKFILL_BYTES_PER_S`` overrides
+    — resolved at cache / backfill construction."""
+    cold = os.environ.get("BLIT_CACHE_COLD_DIR")
+    if cold is None:
+        cold = config.cache_cold_dir
+    elif not cold:
+        cold = None
+    v = os.environ.get("BLIT_BACKFILL_BYTES_PER_S")
+    bps = float(v) if v else config.backfill_bytes_per_s
+    if bps is not None and bps <= 0:
+        bps = None  # unpaced (the scrubber's "no budget" encoding)
+    return {"cold_dir": cold, "backfill_bytes_per_s": bps}
 
 
 def default_window_frames(nfft: int) -> int:

@@ -1174,14 +1174,16 @@ def filter_requests(records: Iterable[Dict], *,
 
 def aggregate_requests(records: Iterable[Dict],
                        slowest: int = 5) -> Dict:
-    """One summary over a record set: counts by status/tier/role,
-    latency p50/p99/max (via the bounded histogram), total bytes, and
-    the slowest records (each carrying its trace id — the page →
-    record → trace pivot)."""
+    """One summary over a record set: counts by status/tier/role —
+    and, for catalog-addressed asks (ISSUE 19: door records carry
+    ``session``/``scan``), by ``session/scan`` — latency p50/p99/max
+    (via the bounded histogram), total bytes, and the slowest records
+    (each carrying its trace id — the page → record → trace pivot)."""
     records = list(records)
     by_status: Dict[str, int] = {}
     by_tier: Dict[str, int] = {}
     by_role: Dict[str, int] = {}
+    by_scan: Dict[str, int] = {}
     lat = HistogramStats()
     total_bytes = 0
     hedges = hedge_wins = 0
@@ -1191,6 +1193,10 @@ def aggregate_requests(records: Iterable[Dict],
         if r.get("tier"):
             by_tier[str(r["tier"])] = by_tier.get(str(r["tier"]), 0) + 1
         by_role[str(r.get("role"))] = by_role.get(str(r.get("role")), 0) + 1
+        if r.get("session"):
+            key = (f"{r['session']}/{r['scan']}" if r.get("scan")
+                   else str(r["session"]))
+            by_scan[key] = by_scan.get(key, 0) + 1
         lat.observe(float(r.get("duration_s", 0.0)))
         total_bytes += int(r.get("bytes", 0) or 0)
         if r.get("hedged"):
@@ -1204,6 +1210,7 @@ def aggregate_requests(records: Iterable[Dict],
         "by_status": by_status,
         "by_tier": by_tier,
         "by_role": by_role,
+        "by_scan": by_scan,
         "p50_s": round(lat.percentile(0.50), 6),
         "p99_s": round(lat.percentile(0.99), 6),
         "max_s": round(lat.vmax, 6),
@@ -1213,6 +1220,7 @@ def aggregate_requests(records: Iterable[Dict],
         "slowest": [
             {k: r.get(k) for k in ("t", "rid", "trace", "role", "client",
                                    "fp", "tier", "peer", "status",
+                                   "session", "scan",
                                    "duration_s") if r.get(k) is not None}
             for r in slow
         ],

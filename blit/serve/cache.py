@@ -36,11 +36,24 @@ evicted FIRST (they are re-derivable from their product), and the disk
 form (``<fp>.wire`` = frame + CRC32 footer) is verified on load exactly
 like the product files (PR 12).
 
+A **cold tier** (ISSUE 19 tentpole #2) sits behind the hot disk tier
+when ``cold_dir`` is set: an object-store-style content-addressed
+layout (``<cold>/<fp[:2]>/<fp>.h5`` + the same meta sidecar, so ``blit
+fsck`` walks it with the rules it already knows).  Hot-tier capacity
+evictions DEMOTE into it (files moved, not copied — the bytes that
+were verified at publish stay the bytes served later) and a cold hit
+is PROMOTED back to hot under the PR-12 CRC manifest check before it
+is served — a rotted cold entry is evicted and reported as a miss,
+never promoted.  A cold miss falls through to the serve layer's
+re-derivation path (the recipe in the meta sidecar — the ``tier ∈
+{ram, wire, disk, cold, derive}`` story on ``/metrics``).
+
 Hit/miss/evict counters land on the :class:`~blit.observability.Timeline`
 (``cache.hit.ram`` / ``cache.hit.disk`` / ``cache.hit.wire`` /
-``cache.miss`` / ``cache.evict.*``) and the ``cache.publish``
-fault-injection point covers the disk publish path for drills
-(blit/faults.py).
+``cache.hit.cold`` / ``cache.miss`` / ``cache.evict.*`` /
+``cache.demote.cold`` / ``cache.promote.cold`` / ``cache.derive``) and
+the ``cache.publish`` fault-injection point covers the disk publish
+path for drills (blit/faults.py).
 """
 
 from __future__ import annotations
@@ -49,6 +62,7 @@ import hashlib
 import json
 import logging
 import os
+import shutil
 import threading
 import time
 import zlib
@@ -156,11 +170,16 @@ class ProductCache:
         *,
         ram_bytes: int = 1 << 30,
         disk_bytes: Optional[int] = None,
+        cold_dir: Optional[str] = None,
         timeline: Optional[Timeline] = None,
     ):
         self.root = root
         self.ram_bytes = max(0, int(ram_bytes))
         self.disk_bytes = disk_bytes
+        # Cold tier (ISSUE 19): requires a hot disk tier to promote
+        # into — a RAM-only cache with a cold_dir would demote nothing
+        # and have nowhere to promote, so it is simply ignored.
+        self.cold_dir = cold_dir if root is not None else None
         self.timeline = timeline if timeline is not None else Timeline()
         self._lock = threading.Lock()
         # fp -> (header, read-only data, nbytes); insertion order = LRU.
@@ -179,9 +198,11 @@ class ProductCache:
         # the drain-time hot-entry hints.
         self._hits_by_fp: "OrderedDict[str, int]" = OrderedDict()
         self.counts: Dict[str, int] = {
-            "hit.ram": 0, "hit.disk": 0, "hit.wire": 0, "miss": 0,
-            "evict.ram": 0, "evict.disk": 0, "evict.corrupt": 0,
-            "evict.wire": 0, "publish": 0, "publish.error": 0,
+            "hit.ram": 0, "hit.disk": 0, "hit.wire": 0, "hit.cold": 0,
+            "miss": 0, "evict.ram": 0, "evict.disk": 0,
+            "evict.corrupt": 0, "evict.wire": 0, "demote.cold": 0,
+            "promote.cold": 0, "derive": 0, "publish": 0,
+            "publish.error": 0,
         }
         if root is not None:
             os.makedirs(root, exist_ok=True)
@@ -192,6 +213,8 @@ class ProductCache:
 
             integrity.watch_quarantine(
                 os.path.join(root, integrity.QUARANTINE_DIR))
+        if self.cold_dir is not None:
+            os.makedirs(self.cold_dir, exist_ok=True)
 
     # -- paths -------------------------------------------------------------
     def data_path(self, fp: str) -> str:
@@ -202,6 +225,12 @@ class ProductCache:
 
     def wire_path(self, fp: str) -> str:
         return os.path.join(self.root, f"{fp}.wire")
+
+    def cold_data_path(self, fp: str) -> str:
+        return os.path.join(self.cold_dir, fp[:2], f"{fp}.h5")
+
+    def cold_meta_path(self, fp: str) -> str:
+        return os.path.join(self.cold_dir, fp[:2], f"{fp}.json")
 
     # -- counters ----------------------------------------------------------
     def _count(self, name: str, n: int = 1) -> None:
@@ -221,9 +250,15 @@ class ProductCache:
     @property
     def hit_rate(self) -> float:
         s = self.stats()
-        served = s["hit.ram"] + s["hit.disk"]
+        served = s["hit.ram"] + s["hit.disk"] + s["hit.cold"]
         total = served + s["miss"]
         return served / total if total else 0.0
+
+    def note_derive(self) -> None:
+        """One miss re-derived through the reduce path — the serve
+        layer reports it so the per-tier story on /metrics covers all
+        of {ram, wire, disk, cold, derive} (ISSUE 19)."""
+        self._count("derive")
 
     # -- RAM tier ----------------------------------------------------------
     def _evict_wire_locked(self, need: int) -> None:
@@ -366,7 +401,13 @@ class ProductCache:
         entries.sort()
         while entries and total + incoming > self.disk_bytes:
             _, fp, size = entries.pop(0)
-            self._disk_evict(fp, "disk")
+            # With a cold tier, a capacity eviction DEMOTES instead of
+            # deleting (ISSUE 19): the entry's bytes move to the
+            # object-store layout, promotable on the next hit.
+            if self.cold_dir is not None and self._demote(fp):
+                self._count("demote.cold")
+            else:
+                self._disk_evict(fp, "disk")
             total -= size
 
     def _disk_load(self, fp: str) -> Optional[Tuple[Dict, np.ndarray]]:
@@ -420,6 +461,146 @@ class ProductCache:
             self._disk_evict(fp, "corrupt")
             return None
         return meta["header"], _frozen(data)
+
+    # -- cold tier (ISSUE 19 tentpole #2) ----------------------------------
+    def _demote(self, fp: str) -> bool:
+        """Move a completed hot-tier entry into the cold layout (data
+        file first, sidecar last — the publish ordering rule, so the
+        cold sidecar's existence marks a complete cold entry).  The
+        derived ``.wire`` body is dropped, not demoted: it re-derives
+        from the product in one encode.  Returns False (caller falls
+        back to a plain eviction) when the move fails midway."""
+        mpath, dpath = self.meta_path(fp), self.data_path(fp)
+        if not (os.path.exists(mpath) and os.path.exists(dpath)):
+            return False
+        try:
+            os.makedirs(os.path.join(self.cold_dir, fp[:2]),
+                        exist_ok=True)
+            shutil.move(dpath, self.cold_data_path(fp))
+            shutil.move(mpath, self.cold_meta_path(fp))
+        except OSError as e:
+            log.warning("demote of %s to the cold tier failed: %s",
+                        fp[:16], e)
+            return False
+        try:
+            os.unlink(self.wire_path(fp))
+        except OSError:
+            pass
+        self.timeline.count("cache.demote.cold")
+        return True
+
+    def _cold_evict(self, fp: str) -> None:
+        for p in (self.cold_meta_path(fp), self.cold_data_path(fp)):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+        self._count("evict.corrupt")
+
+    def _cold_load(self, fp: str) -> Optional[Tuple[Dict, np.ndarray]]:
+        """Cold hit: CRC-verify the cold entry against its manifest
+        sidecar (the promotion gate, PR-12 rules — a cold entry that
+        fails its digest is EVICTED and reported as a miss, never
+        promoted), then PROMOTE it into the hot disk tier byte-for-byte
+        (files copied, sidecar last) and load through the normal hot
+        path."""
+        from blit import integrity
+        from blit.io import read_fbh5_data
+
+        mpath = self.cold_meta_path(fp)
+        dpath = self.cold_data_path(fp)
+        if not os.path.exists(mpath):
+            return None
+        try:
+            with open(mpath) as f:
+                meta = json.load(f)
+            want = integrity.parse_crc(meta.get("crc32"))
+        except (OSError, ValueError):
+            self._cold_evict(fp)
+            return None
+        if want is not None and integrity.cache_verify_enabled():
+            t0 = time.perf_counter()
+            try:
+                got = integrity.crc32_file(dpath)
+            except OSError:
+                got = None
+            integrity.observe_verify(time.perf_counter() - t0,
+                                     self.timeline)
+            if got != want:
+                integrity.incr("integrity.cache.corrupt")
+                log.warning("cold entry %s fails its content digest; "
+                            "evicting", fp[:16])
+                self._cold_evict(fp)
+                return None
+        # Promote: data before sidecar, both via temp + os.replace —
+        # the hot tier sees a whole entry or none, and the bytes are
+        # the EXACT bytes the cold manifest just verified.
+        suffix = f".tmp.{os.getpid()}.{threading.get_ident()}"
+        dtmp = self.data_path(fp) + suffix
+        mtmp = self.meta_path(fp) + suffix
+        try:
+            self._disk_evict_for(
+                int(meta.get("file_bytes") or 0)
+                or (os.path.getsize(dpath) if os.path.exists(dpath)
+                    else 0))
+            shutil.copyfile(dpath, dtmp)
+            os.replace(dtmp, self.data_path(fp))
+            shutil.copyfile(mpath, mtmp)
+            os.replace(mtmp, self.meta_path(fp))
+        except OSError as e:
+            log.warning("promotion of cold entry %s failed: %s",
+                        fp[:16], e)
+            for t in (dtmp, mtmp):
+                try:
+                    os.unlink(t)
+                except OSError:
+                    pass
+            # Serve from the cold files directly this once.
+            try:
+                data = read_fbh5_data(dpath)
+            except Exception:  # noqa: BLE001 — rot past the CRC gate
+                self._cold_evict(fp)
+                return None
+            return meta["header"], _frozen(data)
+        self._count("promote.cold")
+        self._cold_evict_entry_files_after_promote(fp)
+        try:
+            data = read_fbh5_data(self.data_path(fp))
+        except Exception:  # noqa: BLE001 — corrupt past the probe: evict
+            self._disk_evict(fp, "corrupt")
+            return None
+        return meta["header"], _frozen(data)
+
+    def _cold_evict_entry_files_after_promote(self, fp: str) -> None:
+        """After a verified promotion the hot tier owns the entry; the
+        cold copy is removed so one fingerprint lives in exactly one
+        durable tier (a later demotion re-creates it)."""
+        for p in (self.cold_meta_path(fp), self.cold_data_path(fp)):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+
+    def cold_index(self) -> list:
+        """Fingerprints of the completed COLD entries (sidecar
+        present), sorted — the fsck/drill view of the cold tier."""
+        if self.cold_dir is None:
+            return []
+        out = []
+        try:
+            shards = sorted(os.listdir(self.cold_dir))
+        except OSError:
+            return []
+        for shard in shards:
+            sub = os.path.join(self.cold_dir, shard)
+            if not os.path.isdir(sub):
+                continue
+            try:
+                names = os.listdir(sub)
+            except OSError:
+                continue
+            out.extend(n[:-5] for n in names if n.endswith(".json"))
+        return sorted(out)
 
     # -- encoded wire bodies (ISSUE 16 tentpole #3) ------------------------
     def _wire_publish(self, fp: str, body: bytes) -> None:
@@ -523,7 +704,8 @@ class ProductCache:
     # -- public surface ----------------------------------------------------
     def get(self, fp: str) -> Optional[Tuple[Dict, np.ndarray, str]]:
         """``(header, read-only data, tier)`` for a completed entry
-        (``tier`` in ``("ram", "disk")``; disk hits are promoted to RAM),
+        (``tier`` in ``("ram", "disk", "cold")``; disk hits are promoted
+        to RAM, cold hits are CRC-verified and promoted to disk+RAM),
         or ``None`` on a miss."""
         with self._lock:
             hit = self._ram.get(fp)
@@ -546,6 +728,16 @@ class ProductCache:
                     self._note_hit_locked(fp)
                 self.timeline.count("cache.hit.disk")
                 return dict(header), data, "disk"
+        if self.cold_dir is not None:
+            loaded = self._cold_load(fp)
+            if loaded is not None:
+                header, data = loaded
+                with self._lock:
+                    self._ram_put_locked(fp, header, data)
+                    self.counts["hit.cold"] += 1
+                    self._note_hit_locked(fp)
+                self.timeline.count("cache.hit.cold")
+                return dict(header), data, "cold"
         self._count("miss")
         return None
 
@@ -675,7 +867,10 @@ class ProductCache:
         with self._lock:
             if fp in self._ram:
                 return True
-        return self.root is not None and os.path.exists(self.meta_path(fp))
+        if self.root is not None and os.path.exists(self.meta_path(fp)):
+            return True
+        return (self.cold_dir is not None
+                and os.path.exists(self.cold_meta_path(fp)))
 
     def index(self) -> list:
         """Fingerprints of the COMPLETED disk entries (sidecar present)."""

@@ -93,10 +93,12 @@ log = logging.getLogger("blit.serve.fleet")
 # The fleet plane's latency histograms (the MESH_HISTS convention).
 # serialize_s lands on the PEER's timeline (it encodes), the rest on
 # the door's; wire_bytes is a histogram so .total carries the exact
-# byte sum the bench's GB/s needs.
+# byte sum the bench's GB/s needs.  catalog.lookup_s times the door's
+# archive-catalog resolutions and document asks (ISSUE 19) — the
+# archive-day bench's catalog-lookup p50/p99 source.
 FLEET_HISTS = ("fleet.request_s", "fleet.peer_s", "fleet.detect_s",
                "fleet.serialize_s", "fleet.deserialize_s",
-               "fleet.wire_bytes")
+               "fleet.wire_bytes", "catalog.lookup_s")
 
 
 class FleetError(RuntimeError):
@@ -205,7 +207,8 @@ class FleetFrontDoor:
                  hedge_min_n: Optional[int] = None,
                  hot_hits: Optional[int] = None,
                  request_timeout_s: float = 300.0,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 catalog=None):
         d = fleet_defaults(config)
         self.replicas = int(replicas if replicas is not None
                             else d["replicas"])
@@ -268,6 +271,21 @@ class FleetFrontDoor:
         # BLIT_REQUEST_LOG / SiteConfig.request_log_dir is set.
         # (request_log_for also applies the config's exemplars knob.)
         self.request_log = observability.request_log_for("door", config)
+        # Door-side archive catalog (ISSUE 19 tentpole #1): resolves
+        # by-(session, scan) logical asks into the explicit member-path
+        # recipe BEFORE ring routing — so a logical ask fingerprints
+        # (and routes, dedupes, coalesces) identically to its explicit
+        # twin.  Built when BLIT_CATALOG_ROOT / SiteConfig.catalog_root
+        # names a tree, or passed in ready-made.
+        self.catalog = catalog
+        if self.catalog is None:
+            from blit.config import catalog_defaults
+
+            if catalog_defaults(config)["enabled"]:
+                from blit.serve.catalog import CatalogIndex
+
+                self.catalog = CatalogIndex(config=config,
+                                            timeline=self.timeline)
 
     def _make_watch(self, name: str, proc: int):
         if self.lease_dir is not None:
@@ -459,6 +477,12 @@ class FleetFrontDoor:
         status, code, fp, nbytes = "error", 500, None, 0
         trace_id: Optional[str] = None
         outcome: Dict = {}
+        # The LOGICAL address (ISSUE 19): captured before resolution
+        # rewrites the request, so access records group archive traffic
+        # by (session, scan) even though the wire carries member paths.
+        sess = getattr(request, "session", None)
+        scan = getattr(request, "scan", None)
+        is_catalog = getattr(request, "kind", None) == "catalog"
         try:
             with tr.span("fleet.request", client=client) as sp:
                 if sp is not None:
@@ -471,13 +495,21 @@ class FleetFrontDoor:
                             "the replacement", retry_after_s=1.0)
                     self._inflight += 1
                 try:
+                    if sess is not None:
+                        request = self._resolve(request)
                     wire = wire_request(request, priority=priority,
                                         client=client,
                                         deadline_s=deadline_s)
-                    from blit.serve.cache import fingerprint_for
+                    if is_catalog:
+                        from blit.serve.catalog import catalog_fingerprint
 
-                    fp = fingerprint_for(request.reducer(),
-                                         request.raw_source)
+                        fp = catalog_fingerprint(
+                            (request.raw or "").strip("/"))
+                    else:
+                        from blit.serve.cache import fingerprint_for
+
+                        fp = fingerprint_for(request.reducer(),
+                                             request.raw_source)
                     self.timeline.count("fleet.requests")
                     header, data = self._fetch(fp, wire, t0, deadline_s,
                                                rid=rid, outcome=outcome)
@@ -486,6 +518,12 @@ class FleetFrontDoor:
                     # (ISSUE 15 tentpole #3).
                     self.timeline.observe("fleet.request_s",
                                           time.perf_counter() - t_req)
+                    if is_catalog:
+                        # A catalog ask's whole round-trip IS the
+                        # lookup — the archive-day bench's p50/p99.
+                        self.timeline.observe(
+                            "catalog.lookup_s",
+                            time.perf_counter() - t_req)
                     nbytes = data.nbytes
                     status, code = "ok", 200
                     if sp is not None:
@@ -493,7 +531,10 @@ class FleetFrontDoor:
                             sp.attrs or {}, fp=fp[:16],
                             **{k: v for k, v in outcome.items()
                                if v is not None})
-                    self._note_hot(fp, wire["recipe"])
+                    if not is_catalog:
+                        # Catalog documents are query-addressed and
+                        # regenerate on every ask — never warm-hinted.
+                        self._note_hot(fp, wire["recipe"])
                     return header, data
                 finally:
                     with self._drain_cond:
@@ -511,6 +552,7 @@ class FleetFrontDoor:
                     rid=rid, trace=trace_id,
                     role="door", client=client, priority=priority,
                     fp=(fp[:16] if fp else None),
+                    session=sess, scan=scan,
                     tier=outcome.get("tier"),
                     peer=outcome.get("peer"),
                     hedged=outcome.get("hedged"),
@@ -520,6 +562,32 @@ class FleetFrontDoor:
                                      if deadline_s is not None else None),
                     status=status, code=code, bytes=nbytes,
                     duration_s=round(dt, 6))
+
+    def _resolve(self, request):
+        """Resolve a by-(session, scan) logical ask into its explicit
+        member-path twin AT THE DOOR (ISSUE 19 tentpole #1) — before
+        ring routing, so both spellings of one logical product share a
+        fingerprint, an owner and a single-flight group.  Misses raise
+        :class:`~blit.serve.catalog.CatalogMiss` (the 404-class
+        outcome); the lookup's latency feeds ``catalog.lookup_s``."""
+        if self.catalog is None:
+            raise FleetError(
+                "session=/scan= addressing needs a door catalog "
+                "(BLIT_CATALOG_ROOT / SiteConfig.catalog_root)")
+        import dataclasses
+
+        t = time.perf_counter()
+        try:
+            members = self.catalog.resolve(
+                request.session, request.scan,
+                band=request.band, bank=request.bank)
+        finally:
+            self.timeline.observe("catalog.lookup_s",
+                                  time.perf_counter() - t)
+        self.timeline.count("fleet.resolved")
+        return dataclasses.replace(
+            request, raw=tuple(members),
+            session=None, scan=None, band=None, bank=None)
 
     def targets_for(self, fp: str) -> List[_Peer]:
         return [self._peers[n] for n in self.ring.owners(fp)]
@@ -650,6 +718,8 @@ class FleetFrontDoor:
                 last_exc = res
             if isinstance(res, DeadlineExpired):
                 raise res  # the budget itself is gone
+            if type(res).__name__ == "CatalogMiss":
+                raise res  # the ASK is wrong — no replica can fix that
             if isinstance(res, Overloaded):
                 # Alive but refusing — the breaker stays untouched;
                 # another replica may have capacity (or the cache).
@@ -754,6 +824,14 @@ class FleetFrontDoor:
                     retry_after_s=retry_after_from(hdrs, body))
             if status == 504:
                 raise DeadlineExpired(f"peer {p.name}: {msg}")
+            if status == 404:
+                # A catalog miss (ISSUE 19): the CALLER named a
+                # session/scan the archive does not hold — terminal and
+                # breaker-neutral, never a host failure.
+                from blit.serve.catalog import CatalogMiss
+
+                p.breaker.record_success()
+                raise CatalogMiss(f"peer {p.name}: {msg}")
             raise PeerHTTPError(
                 f"peer {p.name} answered HTTP {status}: {msg}")
 
@@ -867,9 +945,11 @@ class FleetFrontDoor:
             inflight = self._inflight
         rep = self.timeline.report()
         counters = {k: row["calls"] for k, row in rep.items()
-                    if k.startswith(("fleet.", "elastic."))
+                    if k.startswith(("fleet.", "elastic.", "catalog."))
                     and isinstance(row, dict) and "calls" in row}
         return {
+            "catalog": (self.catalog.stats()
+                        if self.catalog is not None else None),
             "peers": {n: p.snapshot()
                       for n, p in sorted(self._peers.items())},
             "ring": self.ring.peers(),

@@ -627,6 +627,10 @@ def _error_resp(e: BaseException) -> Tuple:
         return _json_resp(503, {"error": str(e), "etype": "Overloaded",
                                 "retry_after_s": ra},
                           {"Retry-After": f"{ra:.3f}"})
+    if type(e).__name__ == "CatalogMiss":
+        # An archive session/scan the catalog does not hold (ISSUE 19)
+        # — the caller named it wrong: not-found, breaker-neutral.
+        return _json_resp(404, {"error": str(e), "etype": "CatalogMiss"})
     return _json_resp(500, {"error": str(e), "etype": type(e).__name__})
 
 
@@ -815,8 +819,11 @@ class PeerServer:
                 self.service.timeline.observe(
                     "fleet.serialize_s", time.perf_counter() - t_enc)
                 # Retain the encoded body: the NEXT binary hit for
-                # this fingerprint skips the encode entirely.
-                self.service.cache.put_wire(fp, body)
+                # this fingerprint skips the encode entirely.  Catalog
+                # documents regenerate per ask (the tree grows under
+                # them) — never retained (ISSUE 19).
+                if tier != "catalog":
+                    self.service.cache.put_wire(fp, body)
                 return self._wire_resp(body, tier, rid, deflate)
             t_enc = time.perf_counter()
             resp = _json_resp(200, encode_product(header, data),

@@ -92,6 +92,10 @@ def classify_failure(e: BaseException):
         return "overloaded", 503
     if isinstance(e, TimeoutError):
         return "timeout", 504
+    # Archive catalog misses (ISSUE 19) are the caller naming a
+    # session/scan the tree does not hold — not-found, not a fault.
+    if type(e).__name__ == "CatalogMiss":
+        return "notfound", 404
     return "error", 500
 
 

@@ -65,6 +65,20 @@ class ProductRequest:
     and identical concurrent searches single-flight like any other
     request.
 
+    ``kind="catalog"`` asks for the archive catalog document instead of
+    a product (ISSUE 19): ``raw`` carries the query string (``""``
+    lists sessions, ``"<session>"`` one session's scans,
+    ``"<session>/<scan>"`` one scan's membership) and the answer rides
+    the header of an empty result array — served from the process's
+    :class:`~blit.serve.catalog.CatalogIndex`, never cached or reduced.
+
+    ``session``/``scan`` address a product LOGICALLY (ISSUE 19): leave
+    ``raw`` empty and the front door (or a catalog-configured service)
+    resolves the pair into the explicit member-path recipe via the
+    catalog BEFORE fingerprinting — so the logical ask and the
+    equivalent explicit-path ask are the same request (same ring
+    owner, same single-flight group, byte-identical product).
+
     ``kind="stream"`` admits a LIVE job (ISSUE 12 satellite, ROADMAP
     item 5): ``raw`` names a recording still being written, ``out`` the
     product path, and the job runs :func:`blit.stream.stream_reduce`
@@ -101,6 +115,12 @@ class ProductRequest:
     session_s: Optional[float] = None
     replay_rate: Optional[float] = None
     idle_timeout_s: Optional[float] = None
+    # Logical archive addressing (ISSUE 19): resolved into member paths
+    # through the catalog before fingerprinting; ``raw`` stays empty.
+    session: Optional[str] = None
+    scan: Optional[str] = None
+    band: Optional[int] = None
+    bank: Optional[int] = None
 
     def __post_init__(self):
         if isinstance(self.raw, list):
@@ -109,8 +129,29 @@ class ProductRequest:
             raise ValueError(
                 "pass either product= or explicit nfft/nint, not both"
             )
-        if self.kind not in ("filterbank", "hits", "stream"):
+        if self.kind not in ("filterbank", "hits", "stream", "catalog"):
             raise ValueError(f"unknown product kind {self.kind!r}")
+        if self.kind == "catalog":
+            if not isinstance(self.raw, str):
+                raise ValueError("a catalog ask carries its query string "
+                                 "in raw= (\"\", \"<session>\" or "
+                                 "\"<session>/<scan>\")")
+            if self.session is not None or self.scan is not None:
+                raise ValueError("kind='catalog' queries via raw=; "
+                                 "session=/scan= address PRODUCTS")
+        if (self.session is None) != (self.scan is None):
+            raise ValueError("logical addressing needs BOTH session= "
+                             "and scan=")
+        if self.session is not None:
+            if self.kind not in ("filterbank", "hits"):
+                raise ValueError("session=/scan= addressing applies to "
+                                 "derivable products (filterbank/hits)")
+            if self.raw not in ("", ()):
+                raise ValueError("pass either raw= member paths or "
+                                 "session=/scan=, not both")
+        elif self.band is not None or self.bank is not None:
+            raise ValueError("band=/bank= qualify session=/scan= "
+                             "addressing")
         if self.kind != "hits" and any(
             v is not None for v in (self.window_spectra, self.snr_threshold,
                                     self.top_k, self.max_drift_bins)
@@ -140,6 +181,9 @@ class ProductRequest:
         :class:`blit.search.dedoppler.DedopplerReducer` for hits — both
         expose ``reduce(raw) -> (header, array)`` and the fingerprint
         knob surface, so the service treats them alike."""
+        if self.kind == "catalog":
+            raise ValueError("catalog asks are answered from the "
+                             "CatalogIndex, not reduced")
         if self.kind == "stream":
             # The live job's reducer is a plain RawReducer (the stream
             # plane feeds the unchanged batch reducers); constructed
@@ -180,7 +224,8 @@ class ProductRequest:
     # the request — and hence re-derive the entry — after a quarantine.
     _RECIPE_FIELDS = ("product", "nfft", "nint", "stokes", "fqav_by",
                      "dtype", "kind", "window_spectra", "snr_threshold",
-                     "top_k", "max_drift_bins")
+                     "top_k", "max_drift_bins", "session", "scan",
+                     "band", "bank")
 
     def recipe(self) -> Dict:
         """The JSON-able re-derivation recipe of this ask — stored in the
@@ -234,9 +279,11 @@ class _Flight:
 @dataclass
 class Ticket:
     """A claim on one submitted request.  ``source`` records how it was
-    (or will be) satisfied: ``"ram"``/``"disk"`` cache hits complete at
-    submit time; ``"scheduled"`` started the reduction; ``"coalesced"``
-    joined one already in flight."""
+    (or will be) satisfied: ``"ram"``/``"disk"``/``"cold"`` cache hits
+    and ``"catalog"`` answers complete at submit time; ``"scheduled"``
+    started the reduction; ``"coalesced"`` joined one already in
+    flight — both rewrite to ``"derive"`` once the reduction lands, so
+    access records report the serving TIER (ISSUE 19)."""
 
     fingerprint: str
     client: str
@@ -279,12 +326,27 @@ class ProductService:
         config: SiteConfig = DEFAULT,
         pool=None,
         timeline: Optional[Timeline] = None,
+        catalog=None,
     ):
+        from blit.config import archive_defaults, catalog_defaults
+
         self.timeline = timeline if timeline is not None else Timeline()
         self.cache = cache if cache is not None else ProductCache(
             config.cache_dir, ram_bytes=config.cache_ram_bytes,
             timeline=self.timeline,
+            cold_dir=archive_defaults(config)["cold_dir"],
         )
+        # Archive catalog (ISSUE 19): serves kind="catalog" asks and
+        # resolves session=/scan= logical addressing.  Built when
+        # BLIT_CATALOG_ROOT / SiteConfig.catalog_root names a tree (or
+        # passed in ready-made); None otherwise — catalog asks then
+        # fail loudly as caller errors.
+        self.catalog = catalog
+        if self.catalog is None and catalog_defaults(config)["enabled"]:
+            from blit.serve.catalog import CatalogIndex
+
+            self.catalog = CatalogIndex(config=config,
+                                        timeline=self.timeline)
         self.scheduler = scheduler if scheduler is not None else Scheduler(
             max_concurrency=config.serve_max_concurrency,
             queue_depth=config.serve_queue_depth,
@@ -371,6 +433,10 @@ class ProductService:
                     "deadline_s does not apply to kind='stream' live "
                     "sessions (they run for the recording's duration)")
             return self._submit_stream(request, priority, client)
+        if request.kind == "catalog":
+            return self._submit_catalog(request, client)
+        if request.session is not None:
+            request = self.resolve_request(request)
         reducer = request.reducer()
         fp = fingerprint_for(reducer, request.raw_source)
         with self._lock:
@@ -434,8 +500,15 @@ class ProductService:
         A draining service answers ``None`` too, so the refusal runs
         through submit's :class:`Overloaded` → 503 contract unchanged.
         """
-        if self._draining or request.kind == "stream":
+        if self._draining or request.kind in ("stream", "catalog"):
             return None
+        if request.session is not None:
+            try:
+                request = self.resolve_request(request)
+            except Exception:
+                # submit() is the authoritative error surface; a wire
+                # miss just falls back to it.
+                return None
         fp = fingerprint_for(request.reducer(), request.raw_source)
         hit = self.cache.get_wire(fp)
         if hit is None:
@@ -445,6 +518,46 @@ class ProductService:
             self.counts["requests"] += 1
             self.counts["cache_hits"] += 1
         return fp, body, tier
+
+    def resolve_request(self, request: ProductRequest) -> ProductRequest:
+        """Substitute ``session=``/``scan=`` logical addressing with the
+        catalog's member-path list (ISSUE 19).  Identity-preserving by
+        construction: the result IS the equivalent explicit-member-path
+        request — same fingerprint, same ring owner, same single-flight
+        group, byte-identical product."""
+        if request.session is None:
+            return request
+        if self.catalog is None:
+            raise ValueError(
+                "session=/scan= addressing needs a catalog "
+                "(BLIT_CATALOG_ROOT / SiteConfig.catalog_root)")
+        import dataclasses
+
+        members = self.catalog.resolve(
+            request.session, request.scan,
+            band=request.band, bank=request.bank)
+        return dataclasses.replace(
+            request, raw=tuple(members),
+            session=None, scan=None, band=None, bank=None)
+
+    def _submit_catalog(self, request: ProductRequest,
+                        client: str) -> Ticket:
+        """Answer a ``kind="catalog"`` ask from the process's
+        :class:`~blit.serve.catalog.CatalogIndex` — synchronous (an
+        in-RAM index read; a ticket keeps the caller surface uniform),
+        never cached, never coalesced, never queued."""
+        from blit.serve.catalog import catalog_fingerprint
+
+        with self._lock:
+            self.counts["requests"] += 1
+        if self.catalog is None:
+            raise ValueError(
+                "no catalog configured (BLIT_CATALOG_ROOT / "
+                "SiteConfig.catalog_root)")
+        header, data = self.catalog.serve(request.raw)
+        fp = catalog_fingerprint((request.raw or "").strip("/"))
+        self.timeline.count("serve.catalog")
+        return Ticket(fp, client, "catalog", _result=(header, data))
 
     def _submit_stream(self, request: ProductRequest, priority: int,
                        client: str) -> Ticket:
@@ -546,6 +659,14 @@ class ProductService:
                 header, data = reducer.reduce(request.raw_source)
             data = self.cache.put(fp, header, data,
                                   recipe=request.recipe())
+            # Tier accounting (ISSUE 19): this request was satisfied by
+            # DERIVATION — every ticket on the flight (scheduler and
+            # coalescers alike) reports tier "derive", completing the
+            # {ram, wire, disk, cold, derive} per-request tier story.
+            self.cache.note_derive()
+            with self._lock:
+                for t in flight.tickets:
+                    t.source = "derive"
             self._finish(fp, flight, result=(header, data))
             return header, data
         except BaseException as e:  # noqa: BLE001 — per-ticket delivery
@@ -677,9 +798,12 @@ class ProductService:
             out["inflight"] = len(self._flights)
         cache = self.cache.stats()
         out["cache"] = cache
-        served = cache["hit.ram"] + cache["hit.disk"]
+        served = (cache["hit.ram"] + cache["hit.disk"]
+                  + cache.get("hit.cold", 0))
         total = served + cache["miss"]
         out["hit_rate"] = round(served / total, 4) if total else 0.0
+        if self.catalog is not None:
+            out["catalog"] = self.catalog.stats()
         out["queue_wait"] = self.scheduler.wait_percentiles()
         out["budget"] = self.scheduler.effective_budget()
         out["shed"] = self.scheduler.shed_level()

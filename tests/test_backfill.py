@@ -1,0 +1,174 @@
+"""``blit backfill`` (ISSUE 19 tentpole #3): walk an archive root,
+derive + publish every product, resumable via the fsync-before-claim
+completion ledger — a kill mid-run never re-derives completed products
+on resume and always finishes byte-identical to an uninterrupted run;
+torn ledger tail lines and the publish→claim crash window both fail
+toward re-work, never toward fake completion."""
+
+import glob
+import hashlib
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+pytest.importorskip("jax")
+
+from blit.__main__ import main  # noqa: E402
+from blit.testing import build_observation_tree  # noqa: E402
+
+SESSION = "AGBT25A_999_01"
+NFFT = 16
+RAW_NTIME = 64  # x2 blocks/file = 8 frames at nfft=16
+
+
+@pytest.fixture
+def archive(tmp_path):
+    root = str(tmp_path / "archive")
+    build_observation_tree(root, SESSION, scans=("0001", "0002"),
+                           players=((0, 0), (0, 1)), kind="raw",
+                           nchans=2, raw_ntime=RAW_NTIME, nfiles=1)
+    return root
+
+
+def run_backfill(archive, cache_dir, *extra):
+    out = cache_dir + ".report.json"
+    rc = main(["backfill", archive, "--cache-dir", cache_dir,
+               "--nfft", str(NFFT), "--bytes-per-s", "0",
+               "--json-out", out, *extra])
+    with open(out) as f:
+        return rc, json.load(f)
+
+
+def cache_digests(cache_dir):
+    return {os.path.basename(p):
+            hashlib.sha256(open(p, "rb").read()).hexdigest()
+            for p in glob.glob(os.path.join(cache_dir, "*.h5"))}
+
+
+class TestBackfill:
+    def test_full_run_derives_every_product(self, tmp_path, archive):
+        rc, rep = run_backfill(archive, str(tmp_path / "cache"))
+        assert rc == 0
+        assert rep["products_total"] == 4  # 2 scans x 2 players
+        assert rep["derived"] == 4 and not rep["errors"]
+        assert len(cache_digests(str(tmp_path / "cache"))) == 4
+
+    def test_rerun_is_a_ledger_noop(self, tmp_path, archive):
+        cd = str(tmp_path / "cache")
+        run_backfill(archive, cd)
+        rc, rep = run_backfill(archive, cd)
+        assert rc == 0
+        assert rep["derived"] == 0
+        assert rep["skipped_ledger"] == rep["products_total"] == 4
+
+    def test_interrupted_resume_matches_uninterrupted(self, tmp_path,
+                                                      archive):
+        one = str(tmp_path / "one-shot")
+        run_backfill(archive, one)
+        resumed = str(tmp_path / "resumed")
+        rc, rep = run_backfill(archive, resumed, "--limit", "2")
+        assert rc == 0 and rep["derived"] == 2
+        rc, rep = run_backfill(archive, resumed)
+        assert rc == 0
+        assert rep["skipped_ledger"] == 2 and rep["derived"] == 2
+        assert cache_digests(one) == cache_digests(resumed)
+
+    def test_torn_ledger_tail_rederives_not_trusts(self, tmp_path,
+                                                   archive):
+        cd = str(tmp_path / "cache")
+        run_backfill(archive, cd, "--limit", "2")
+        ledger = os.path.join(cd, "backfill.ledger.jsonl")
+        lines = open(ledger).read().splitlines()
+        torn = lines[-1][: len(lines[-1]) // 2]  # half a record
+        with open(ledger, "w") as f:
+            f.write("\n".join(lines[:-1]) + "\n" + torn)
+        rc, rep = run_backfill(archive, cd)
+        assert rc == 0
+        # The torn claim does not count as completed — its product is
+        # found already published (the publish→claim window) and is
+        # re-CLAIMED without re-deriving.
+        assert rep["skipped_ledger"] == 1
+        assert rep["skipped_cached"] == 1
+        assert rep["derived"] == 2
+        # The healed ledger now covers everything.
+        rc, rep = run_backfill(archive, cd)
+        assert rep["skipped_ledger"] == 4
+
+    def test_publish_claim_window_claims_without_rederive(
+            self, tmp_path, archive):
+        cd = str(tmp_path / "cache")
+        run_backfill(archive, cd)
+        digests = cache_digests(cd)
+        ledger = os.path.join(cd, "backfill.ledger.jsonl")
+        lines = open(ledger).read().splitlines()
+        with open(ledger, "w") as f:
+            f.write("\n".join(lines[:-1]) + "\n")
+        mtimes = {p: os.path.getmtime(p)
+                  for p in glob.glob(os.path.join(cd, "*.h5"))}
+        rc, rep = run_backfill(archive, cd)
+        assert rc == 0
+        assert rep["skipped_cached"] == 1 and rep["derived"] == 0
+        assert cache_digests(cd) == digests
+        # Published files untouched — the claim is ledger-only.
+        assert mtimes == {p: os.path.getmtime(p) for p in mtimes}
+
+    def test_sigkill_drill_resumes_byte_identical(self, tmp_path,
+                                                  archive):
+        # The acceptance kill drill, for real: pace the walker hard so
+        # each product sleeps off a large debt, SIGKILL it after the
+        # first claim lands, then resume unpaced — completed products
+        # are not re-derived and the result matches an uninterrupted
+        # run byte for byte.
+        one = str(tmp_path / "one-shot")
+        run_backfill(archive, one)
+        cd = str(tmp_path / "killed")
+        ledger = os.path.join(cd, "backfill.ledger.jsonl")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "blit", "backfill", archive,
+             "--cache-dir", cd, "--nfft", str(NFFT),
+             "--bytes-per-s", "10"],  # ~minutes of debt per product
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            env=env)
+        try:
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                if os.path.exists(ledger) and open(ledger).read().count(
+                        "\n") >= 1:
+                    break
+                if proc.poll() is not None:
+                    pytest.fail("backfill exited before the kill")
+                time.sleep(0.05)
+            else:
+                pytest.fail("first claim never landed")
+        finally:
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(30)
+        claimed_before = open(ledger).read().count("\n")
+        assert claimed_before >= 1
+        rc, rep = run_backfill(archive, cd)
+        assert rc == 0
+        assert rep["skipped_ledger"] + rep["skipped_cached"] >= claimed_before
+        assert rep["derived"] <= 4 - claimed_before
+        assert cache_digests(one) == cache_digests(cd)
+
+    def test_errors_are_reported_not_fatal(self, tmp_path, archive):
+        # A rotted member errors THAT product and keeps going — rc 1,
+        # the rest derived.  (The crawl indexes by NAME; the rot is
+        # only discovered when the reduce opens the recording.)
+        victims = glob.glob(os.path.join(
+            archive, SESSION, "GUPPI", "BLP01", "*_0002.0000.raw"))
+        assert victims
+        with open(victims[0], "wb") as f:
+            f.write(b"not a GUPPI recording")
+        cd = str(tmp_path / "cache")
+        rc, rep = run_backfill(archive, cd)
+        assert rc == 1
+        assert len(rep["errors"]) == 1 and "BLP01" in rep["errors"][0]
+        assert rep["derived"] == 3
+        assert rep["products_total"] == 4

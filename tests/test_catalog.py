@@ -1,0 +1,294 @@
+"""Archive catalog (blit/serve/catalog.py; ISSUE 19 tentpole #1): the
+session/scan/product index built from the inventory crawl — lookup
+document shapes, by-(session, scan, player) resolution into member
+paths, mtime-invalidated incremental rescan (sessions appearing
+mid-flight), the bounded TTL'd negative-lookup cache, malformed player
+dirs rejected by the corrected PLAYER_RE, and door/peer catalog
+agreement over the real fleet wire (addressed asks byte-identical to
+explicit-member asks)."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+from blit.config import DEFAULT  # noqa: E402
+from blit.observability import Timeline  # noqa: E402
+from blit.serve import (  # noqa: E402
+    PeerServer,
+    ProductCache,
+    ProductRequest,
+    ProductService,
+    Scheduler,
+)
+from blit.serve.cache import fingerprint_for  # noqa: E402
+from blit.serve.catalog import (  # noqa: E402
+    CatalogIndex,
+    CatalogMiss,
+    catalog_fingerprint,
+)
+from blit.serve.fleet import FleetFrontDoor  # noqa: E402
+from blit.testing import build_observation_tree  # noqa: E402
+
+SESSION = "AGBT25A_999_01"
+NFFT = 64
+RAW_NTIME = 6 * NFFT  # x2 blocks/file = 12 PFB frames' worth
+
+
+@pytest.fixture
+def archive(tmp_path):
+    root = str(tmp_path / "archive")
+    build_observation_tree(root, SESSION, scans=("0001", "0002"),
+                           players=((0, 0), (0, 1)), kind="raw",
+                           nchans=2, raw_ntime=RAW_NTIME, nfiles=2)
+    return root
+
+
+def make_index(root, **kw):
+    kw.setdefault("rescan_s", 0.0)
+    return CatalogIndex(root, **kw)
+
+
+class TestCatalogFingerprint:
+    def test_stable_and_query_keyed(self):
+        assert catalog_fingerprint("") == catalog_fingerprint("")
+        assert catalog_fingerprint("a") != catalog_fingerprint("b")
+
+    def test_never_collides_with_product_space(self, archive):
+        # A catalog ask hashes a namespaced string, never file bytes —
+        # even a query spelling a real path keys differently than any
+        # product fingerprint shape (64 hex chars is all they share).
+        fp = catalog_fingerprint(f"{SESSION}/0001")
+        assert len(fp) == 64
+        assert fp != catalog_fingerprint(f"{SESSION}/0002")
+
+
+class TestLookupShapes:
+    def test_all_sessions_document(self, archive):
+        doc = make_index(archive).lookup()
+        assert doc["sessions"][SESSION]["scans"] == 2
+        assert doc["sessions"][SESSION]["files"] == 8  # 2 scans x 2 players x 2 members
+
+    def test_session_document_lists_scans(self, archive):
+        doc = make_index(archive).lookup(SESSION)
+        assert sorted(doc["scans"]) == ["0001", "0002"]
+        sc = doc["scans"]["0001"]
+        assert sc["bands"] == [0] and sc["banks"] == [0, 1]
+        assert sc["sequences"] == 2
+        assert "members" not in sc  # membership only on the scan ask
+
+    def test_scan_document_carries_members(self, archive):
+        doc = make_index(archive).lookup(SESSION, "0001")
+        members = doc["members"]
+        assert sorted(members) == ["00", "01"]
+        for paths in members.values():
+            assert len(paths) == 2
+            assert all(os.path.exists(p) for p in paths)
+
+    def test_scan_keys_are_zero_padded_strings(self, archive):
+        # The naming grammar's scan field is a STRING ("0001"), and the
+        # catalog must key exactly like the wire query partition does.
+        idx = make_index(archive)
+        idx.lookup(SESSION, "0001")
+        with pytest.raises(CatalogMiss):
+            idx.lookup(SESSION, "1")
+
+
+class TestResolve:
+    def test_resolves_unique_player_sequence(self, archive):
+        idx = make_index(archive)
+        members = idx.resolve(SESSION, "0001", band=0, bank=1)
+        assert len(members) == 2
+        assert members == sorted(members)
+        assert all("blc01" in os.path.basename(p) for p in members)
+
+    def test_ambiguous_without_player_is_loud(self, archive):
+        with pytest.raises(CatalogMiss, match="2 RAW sequences"):
+            make_index(archive).resolve(SESSION, "0001")
+
+    def test_absent_player_is_a_miss(self, archive):
+        with pytest.raises(CatalogMiss, match="no RAW sequence"):
+            make_index(archive).resolve(SESSION, "0001", band=3, bank=7)
+
+
+class TestMalformedPlayers:
+    def test_malformed_player_dirs_never_index(self, archive):
+        # The corrected PLAYER_RE admits BLP[0-7][0-7] only — a dir
+        # named outside the grammar must be skipped by the crawl even
+        # when its files parse.
+        for bad in ("BLP99", "BLPXY", "BLP0", "GPU00"):
+            d = os.path.join(archive, SESSION, "GUPPI", bad)
+            os.makedirs(d)
+            with open(os.path.join(
+                    d, "blc00_guppi_59897_21221_HD_84406_0001.0000.raw"),
+                    "wb") as f:
+                f.write(b"not a recording")
+        doc = make_index(archive).lookup(SESSION, "0001")
+        assert sorted(doc["members"]) == ["00", "01"]
+        assert doc["bands"] == [0] and doc["banks"] == [0, 1]
+
+
+class TestRescan:
+    def test_session_appearing_mid_flight(self, archive):
+        idx = make_index(archive)
+        with pytest.raises(CatalogMiss):
+            idx.lookup("AGBT25A_999_02")
+        build_observation_tree(archive, "AGBT25A_999_02",
+                               scans=("0003",), players=((1, 0),),
+                               kind="raw", nchans=2,
+                               raw_ntime=RAW_NTIME, nfiles=1)
+        doc = idx.lookup("AGBT25A_999_02", "0003")
+        assert sorted(doc["members"]) == ["10"]
+
+    def test_new_scan_invalidates_only_its_session(self, archive):
+        idx = make_index(archive)
+        idx.lookup(SESSION)
+        base = idx.stats()["rescans"]
+        build_observation_tree(archive, SESSION, scans=("0009",),
+                               players=((0, 0),), kind="raw", nchans=2,
+                               raw_ntime=RAW_NTIME, nfiles=1)
+        doc = idx.lookup(SESSION)
+        assert "0009" in doc["scans"]
+        assert idx.stats()["rescans"] == base + 1
+
+    def test_unchanged_tree_is_never_recrawled(self, archive):
+        idx = make_index(archive)
+        idx.lookup(SESSION)
+        base = idx.stats()["rescans"]
+        for _ in range(5):
+            idx.lookup(SESSION, "0002")
+        assert idx.stats()["rescans"] == base
+
+
+class TestNegativeCache:
+    def test_repeat_miss_skips_the_tree(self, archive):
+        idx = make_index(archive, negative_ttl_s=30.0)
+        with pytest.raises(CatalogMiss):
+            idx.lookup(SESSION, "9999")
+        refreshes = idx.stats()["refreshes"]
+        with pytest.raises(CatalogMiss, match="negative-cached"):
+            idx.lookup(SESSION, "9999")
+        assert idx.stats()["refreshes"] == refreshes
+        assert idx.stats()["neg_hits"] == 1
+
+    def test_expiry_rechecks_and_finds_late_data(self, archive):
+        idx = make_index(archive, negative_ttl_s=0.05)
+        with pytest.raises(CatalogMiss):
+            idx.lookup(SESSION, "0042")
+        build_observation_tree(archive, SESSION, scans=("0042",),
+                              players=((0, 0),), kind="raw", nchans=2,
+                              raw_ntime=RAW_NTIME, nfiles=1)
+        # Inside the TTL the miss is still served from the cache...
+        with pytest.raises(CatalogMiss, match="negative-cached"):
+            idx.lookup(SESSION, "0042")
+        time.sleep(0.06)
+        # ...and past it the rescan finds the late-landing scan.
+        doc = idx.lookup(SESSION, "0042")
+        assert sorted(doc["members"]) == ["00"]
+
+    def test_bounded_by_negative_max(self, archive):
+        idx = make_index(archive, negative_ttl_s=30.0, negative_max=4)
+        for i in range(10):
+            with pytest.raises(CatalogMiss):
+                idx.lookup(SESSION, f"9{i:03d}")
+        assert idx.stats()["negative_entries"] == 4
+
+
+class TestServeSurface:
+    def test_serve_shapes_ride_the_product_result(self, archive):
+        idx = make_index(archive)
+        hdr, data = idx.serve("")
+        assert hdr["kind"] == "catalog" and SESSION in hdr["sessions"]
+        assert data.shape == (0, 1, 0) and not data.flags.writeable
+        hdr, _ = idx.serve(f"{SESSION}/0001")
+        assert sorted(hdr["members"]) == ["00", "01"]
+
+    def test_serve_miss_raises(self, archive):
+        with pytest.raises(CatalogMiss):
+            make_index(archive).serve("NOPE")
+
+
+class TestFleetAgreement:
+    """Door and peer each crawl the SAME root independently; the wire
+    must agree — addressed product asks byte-identical to explicit
+    member-path asks, and catalog documents identical modulo the
+    serving generation."""
+
+    @pytest.fixture
+    def fleet(self, tmp_path, archive):
+        config = DEFAULT.with_(catalog_root=archive)
+        lease_dir = str(tmp_path / "leases")
+        tl = Timeline()
+        svc = ProductService(
+            cache=ProductCache(str(tmp_path / "cache0"),
+                               ram_bytes=1 << 24, timeline=tl),
+            scheduler=Scheduler(max_concurrency=2, queue_depth=8,
+                                timeline=tl, retry_seed=0),
+            timeline=tl, config=config)
+        ps = PeerServer(svc, name="peer0", lease_dir=lease_dir, proc=0,
+                        beat_interval_s=0.05).start()
+        door = FleetFrontDoor({"peer0": ps.url}, lease_dir=lease_dir,
+                              timeline=Timeline(), peer_ttl_s=0.6,
+                              poll_s=0.05, hedge_floor_s=5.0,
+                              request_timeout_s=60.0, config=config)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            door.observe()
+            if all(p.watch.seen for p in door._peers.values()):
+                break
+            time.sleep(0.05)
+        yield door, ps
+        door.close()
+        ps.close()
+        svc.close(5)
+
+    def test_addressed_equals_explicit_member_ask(self, fleet):
+        door, _ = fleet
+        addressed = ProductRequest(raw="", session=SESSION,
+                                   scan="0001", band=0, bank=1,
+                                   nfft=NFFT, nint=1)
+        _, d1 = door.get(addressed, client="t")
+        members = door.catalog.resolve(SESSION, "0001", band=0, bank=1)
+        explicit = ProductRequest(raw=tuple(members), nfft=NFFT, nint=1)
+        _, d2 = door.get(explicit, client="t")
+        assert d1.dtype == d2.dtype and d1.shape == d2.shape
+        assert d1.tobytes() == d2.tobytes()
+        # Same fingerprint by construction: resolution happened at the
+        # door, BEFORE routing — one owner, one cache entry.
+        fp1 = fingerprint_for(addressed.reducer()
+                              if addressed.session is None else
+                              explicit.reducer(), explicit.raw_source)
+        assert door._peers["peer0"].breaker.failures == 0
+        svc_cache = fleet[1].service.cache
+        assert svc_cache.counts.get("miss", 0) == 1
+        assert fp1 in svc_cache._ram
+
+    def test_catalog_documents_agree_across_the_wire(self, fleet):
+        door, ps = fleet
+        hdr, data = door.get(ProductRequest(kind="catalog",
+                                            raw=f"{SESSION}/0001"),
+                             client="t")
+        local = CatalogIndex(ps.service.catalog.root, rescan_s=0.0)
+        want, _ = local.serve(f"{SESSION}/0001")
+        for k in ("kind", "query", "session", "scan", "members",
+                  "bands", "banks", "src"):
+            assert hdr[k] == want[k]
+        assert data.size == 0
+
+    def test_unknown_scan_is_a_clean_miss_not_a_breaker_trip(self, fleet):
+        door, _ = fleet
+        with pytest.raises(CatalogMiss):
+            door.get(ProductRequest(kind="catalog",
+                                    raw=f"{SESSION}/8888"), client="t")
+        assert door._peers["peer0"].breaker.failures == 0
+
+    def test_addressed_miss_is_terminal_at_the_door(self, fleet):
+        door, _ = fleet
+        with pytest.raises((CatalogMiss, Exception)) as ei:
+            door.get(ProductRequest(raw="", session="NO_SUCH",
+                                    scan="0001", nfft=NFFT, nint=1),
+                     client="t")
+        assert "NO_SUCH" in str(ei.value)

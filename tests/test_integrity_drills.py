@@ -29,6 +29,20 @@ def _clean_faults():
     faults.reset_counters()
 
 
+@pytest.fixture(autouse=True)
+def _isolate_quarantine_watch():
+    """The quarantine watch registry is process-wide by design (a serve
+    process watches the caches it opened); restore it after each test so
+    a drill's leftover quarantine cannot degrade /healthz for unrelated
+    test files (test_monitor's clean-process assertions)."""
+    with integrity._WATCH_LOCK:
+        saved = set(integrity._WATCHED_QUARANTINES)
+    yield
+    with integrity._WATCH_LOCK:
+        integrity._WATCHED_QUARANTINES.clear()
+        integrity._WATCHED_QUARANTINES.update(saved)
+
+
 def _kw():
     return dict(nfft=NFFT, chunk_frames=CF, tune_online=False)
 
@@ -254,3 +268,81 @@ class TestHitsManifestDrills:
         assert _bytes(out) == _bytes(ref)
         doc, problems = integrity.verify_product(out)
         assert doc["complete"] and not problems
+
+
+def _flip_byte(path, back=9):
+    with open(path, "r+b") as f:
+        f.seek(os.path.getsize(path) - back)
+        b = f.read(1)
+        f.seek(-1, 1)
+        f.write(bytes([b[0] ^ 0x20]))
+
+
+class TestColdTierDrills:
+    """Corrupt-cold-entry drills (ISSUE 19 satellite): the cold tier
+    shares the hot tier's sidecar convention, so ``blit fsck`` walks it
+    with the SAME detection/quarantine rules — and ``--repair``
+    re-derives a quarantined cold entry through its recorded recipe."""
+
+    def _cold_tree(self, tmp_path):
+        from blit.serve.cache import ProductCache, fingerprint_for
+        from blit.serve.service import ProductRequest
+
+        raw = str(tmp_path / "cold-drill.raw")
+        synth_raw(raw, nblocks=2, obsnchan=2, ntime_per_block=512,
+                  seed=11)
+        req = ProductRequest(raw=raw, nfft=NFFT, nint=1)
+        reducer = req.reducer()
+        fp = fingerprint_for(reducer, raw)
+        header, data = reducer.reduce(raw)
+        hot = str(tmp_path / "hot")
+        cold = str(tmp_path / "cold")
+        c = ProductCache(hot, ram_bytes=0, cold_dir=cold)
+        c.put(fp, header, data, recipe=req.recipe())
+        assert c._demote(fp)
+        return hot, cold, c, fp, data
+
+    def test_clean_cold_tier_passes(self, tmp_path):
+        _hot, cold, _c, _fp, _data = self._cold_tree(tmp_path)
+        rep = integrity.fsck(cold)
+        assert rep["clean"] and rep["checked"] == 1 and rep["ok"] == 1
+
+    def test_corrupt_cold_entry_quarantined_and_repaired(self, tmp_path):
+        hot, cold, c, fp, data = self._cold_tree(tmp_path)
+        _flip_byte(c.cold_data_path(fp))
+        rep = integrity.fsck(cold)
+        assert not rep["clean"]
+        assert f"{fp}.h5" in rep["bad"][0]["path"]
+        assert rep["bad"][0]["quarantined"]
+        assert not os.path.exists(c.cold_data_path(fp))
+        # --repair re-derives the entry from its recorded recipe INTO
+        # the cold shard it was quarantined from...
+        rep = integrity.fsck(cold, repair=True)
+        assert rep["clean"] and rep["repaired"], rep
+        rep2 = integrity.fsck(cold)
+        assert rep2["clean"] and rep2["checked"] == 1
+        # ...and the repaired entry serves byte-identical again.
+        c2 = __import__("blit.serve.cache",
+                        fromlist=["ProductCache"]).ProductCache(
+            hot, ram_bytes=1 << 20, cold_dir=cold)
+        got = c2.get(fp)
+        assert got is not None and got[2] == "cold"
+        np.testing.assert_array_equal(got[1], data)
+
+    def test_cli_walks_both_tiers(self, tmp_path):
+        import json as _json
+
+        from blit.__main__ import main
+
+        hot, cold, c, fp, _data = self._cold_tree(tmp_path)
+        out = str(tmp_path / "fsck.json")
+        assert main(["fsck", hot, "--cold-dir", cold,
+                     "--json-out", out]) == 0
+        rep = _json.load(open(out))
+        assert rep["clean"] and rep["cold_root"] == os.path.abspath(cold)
+        _flip_byte(c.cold_data_path(fp))
+        assert main(["fsck", hot, "--cold-dir", cold,
+                     "--json-out", out]) == 1
+        rep = _json.load(open(out))
+        assert not rep["clean"]
+        assert any(f"{fp}.h5" in b["path"] for b in rep["bad"])

@@ -609,6 +609,44 @@ class TestBenchDiff:
         assert m == {"fleet_hit_rate": 0.94, "fleet_wire_gbps": 0.028,
                      "wire_speedup": 1.12, "fleet_request_p99_s": 1.5}
 
+    def test_archive_day_r02_keys_pin(self):
+        # ISSUE 19: the archive-plane record's new keys — catalog
+        # lookup quantiles (lower-is-better), per-tier hit rates and
+        # SLO attainment (higher-is-better) — must ALL extract, while
+        # tier_derive_rate stays report-only (a rising derive rate is
+        # a regression, so it must not ride the higher-is-better
+        # extractor).
+        from blit.monitor import metric_lower_is_better
+
+        rep = {"serve_bench": "archive-day",
+               "config": {"backend": "cpu"},
+               "metrics": {"catalog_lookup_p50_s": 0.0001,
+                           "catalog_lookup_p99_s": 0.002,
+                           "tier_ram_hit_rate": 0.5,
+                           "tier_disk_hit_rate": 0.1,
+                           "tier_wire_hit_rate": 0.2,
+                           "tier_cold_hit_rate": 0.05,
+                           "tier_derive_rate": 0.15,
+                           "slo_attained": 0.98}}
+        m = bench_metrics(rep)
+        assert set(m) == {"catalog_lookup_p50_s",
+                          "catalog_lookup_p99_s",
+                          "tier_ram_hit_rate", "tier_disk_hit_rate",
+                          "tier_wire_hit_rate", "tier_cold_hit_rate",
+                          "slo_attained"}
+        assert metric_lower_is_better("catalog_lookup_p99_s")
+        assert not metric_lower_is_better("tier_cold_hit_rate")
+        assert not metric_lower_is_better("slo_attained")
+        # And the band inverts for the catalog quantile exactly like
+        # the serve quantiles.
+        def r(p99):
+            return {"config": {"backend": "cpu"},
+                    "metrics": {"catalog_lookup_p99_s": p99}}
+
+        worse = bench_diff(r(0.08), [r(0.002), r(0.003)], rel_tol=0.2)
+        assert worse["metrics"]["catalog_lookup_p99_s"][
+            "status"] == "regress"
+
     def test_latency_quantiles_invert_the_band(self):
         # Lower-is-better: a p99 RISING above the noise band regresses;
         # dropping below it improves.  Higher-is-better metrics in the

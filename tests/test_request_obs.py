@@ -300,7 +300,7 @@ class TestServiceRecords:
         svc = self._service(tmp_path)
         req = make_req(tmp_path)
         try:
-            svc.get(req, timeout=120, client="a")       # ok (scheduled)
+            svc.get(req, timeout=120, client="a")       # ok (derived)
             svc.get(req, timeout=120, client="a")       # ok (ram hit)
             with pytest.raises(DeadlineExpired):
                 # A burned deadline is rejected at admission → 504.
@@ -317,7 +317,7 @@ class TestServiceRecords:
         assert len(recs) == 4
         ok = [r for r in recs if r["status"] == "ok"]
         assert len(ok) == 2
-        assert ok[0]["tier"] == "scheduled" and ok[0]["code"] == 200
+        assert ok[0]["tier"] == "derive" and ok[0]["code"] == 200
         assert ok[1]["tier"] == "ram" and ok[1]["bytes"] > 0
         dead = [r for r in recs if r["client"] == "dead"][0]
         assert dead["status"] == "deadline" and dead["code"] == 504
@@ -416,7 +416,7 @@ class TestTracePropagation:
         assert sr[0]["parent"] in {d["span"] for d in fd}
         # The hedge verdict + routing outcome land on the parent span.
         assert fr[0]["attrs"]["peer"] in ("peer0", "peer1")
-        assert fr[0]["attrs"]["tier"] == "scheduled"
+        assert fr[0]["attrs"]["tier"] == "derive"
 
     def test_wire_headers_reactivate_the_context(self, fleet,
                                                  tmp_path):
@@ -430,7 +430,7 @@ class TestTracePropagation:
             timeout=60.0,
             headers={TRACE_HEADER: "cafe.1", SPAN_HEADER: "cafe.2"})
         assert status == 200
-        assert hdrs.get(TIER_HEADER.lower()) == "scheduled"
+        assert hdrs.get(TIER_HEADER.lower()) == "derive"
         sr = spans_by_name("serve.reduce")
         assert sr and sr[0]["trace"] == "cafe.1"
         assert sr[0]["parent"] == "cafe.2"
@@ -529,7 +529,7 @@ class TestDoorRecords:
         assert by_status["shed"] == ("overloaded", 503)
         ok = [r for r in recs if r["client"] == "ok"][0]
         assert ok["peer"] in ("peer0", "peer1")
-        assert ok["tier"] == "scheduled" and ok["bytes"] > 0
+        assert ok["tier"] == "derive" and ok["bytes"] > 0
         assert ok["trace"] and ok["rid"]
 
     def test_peer_record_rides_the_doors_request_id(self, fleet,
@@ -605,6 +605,34 @@ class TestRequestsCLI:
         assert agg["records"] == 2
         assert agg["by_status"] == {"ok": 1, "overloaded": 1}
         assert agg["slowest"][0]["trace"] == "t.2"
+
+    def test_aggregate_groups_by_session_scan(self, tmp_path, capsys):
+        # ISSUE 19 satellite: door records for catalog-addressed asks
+        # carry session/scan, and the aggregate groups on them — the
+        # operator's "which scans are hot" view.
+        from blit.__main__ import main
+
+        rl = RequestLog(str(tmp_path / "requests-door-h-1.jsonl"))
+        for i in range(3):
+            rl.record(rid=f"s{i}", trace=f"s.{i}", role="door",
+                      client="c", status="ok", code=200, tier="ram",
+                      duration_s=0.002, bytes=5,
+                      session="AGBT25A_999_01", scan="0001")
+        rl.record(rid="x", trace="s.9", role="door", client="c",
+                  status="ok", code=200, tier="ram", duration_s=0.9,
+                  bytes=5, session="AGBT25A_999_01", scan="0002")
+        rl.record(rid="y", trace="s.10", role="door", client="c",
+                  status="ok", code=200, tier="derive",
+                  duration_s=0.003, bytes=5)  # explicit-path ask
+        rl.close()
+        assert main(["requests", str(tmp_path), "--aggregate",
+                     "--json"]) == 0
+        agg = json.loads(capsys.readouterr().out)
+        assert agg["by_scan"] == {"AGBT25A_999_01/0001": 3,
+                                  "AGBT25A_999_01/0002": 1}
+        slow = agg["slowest"][0]
+        assert slow["session"] == "AGBT25A_999_01"
+        assert slow["scan"] == "0002"
 
 
 class TestTraceViewFleet:

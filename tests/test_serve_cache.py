@@ -283,3 +283,95 @@ class TestConcurrentAccess:
         assert sorted(os.listdir(c.root)) == [
             "a" * 64 + ".h5", "a" * 64 + ".json"
         ]
+
+
+class TestColdTier:
+    """Object-store-style cold tier behind the hot disk (ISSUE 19
+    tentpole #2): demotion on capacity eviction, manifest/CRC-verified
+    promotion on a cold hit (byte-identical to the published product),
+    rotted entries evicted — never promoted — and the ``tier ∈ {ram,
+    wire, disk, cold, derive}`` reporting surface."""
+
+    def make(self, tmp_path, **kw):
+        kw.setdefault("ram_bytes", 1 << 20)
+        return ProductCache(str(tmp_path / "hot"),
+                            cold_dir=str(tmp_path / "cold"), **kw)
+
+    def publish_one(self, c, seed=1, nsamps=8):
+        hdr, data = entry(nsamps=nsamps, seed=seed)
+        fp = f"{seed:02x}" * 32
+        c.put(fp, hdr, data)
+        return fp, hdr, data
+
+    def test_cold_hit_promotes_byte_identical(self, tmp_path):
+        c = self.make(tmp_path)
+        fp, _, data = self.publish_one(c)
+        hot_bytes = open(c.data_path(fp), "rb").read()
+        assert c._demote(fp)
+        assert not os.path.exists(c.data_path(fp))
+        # A fresh process (empty RAM tier) must find the entry cold,
+        # verify it against the manifest, and promote it back hot.
+        c2 = ProductCache(c.root, ram_bytes=1 << 20,
+                          cold_dir=c.cold_dir)
+        got = c2.get(fp)
+        assert got is not None
+        hdr2, data2, tier = got
+        assert tier == "cold"
+        np.testing.assert_array_equal(data2, data)
+        assert c2.counts["hit.cold"] == 1
+        assert c2.counts["promote.cold"] == 1
+        # Promotion is the EXACT published bytes, and the cold copy is
+        # retired once the hot tier holds them again.
+        assert open(c2.data_path(fp), "rb").read() == hot_bytes
+        assert not os.path.exists(c2.cold_data_path(fp))
+        # The next ask is a plain RAM hit — cold served once.
+        assert c2.get(fp)[2] == "ram"
+
+    def test_contains_sees_cold_entries(self, tmp_path):
+        c = self.make(tmp_path)
+        fp, _, _ = self.publish_one(c)
+        c._demote(fp)
+        assert c.contains(fp)
+        assert not c.contains("9" * 64)
+
+    def test_capacity_eviction_demotes_instead_of_deleting(self, tmp_path):
+        c = self.make(tmp_path, disk_bytes=1)  # one entry at most
+        fp, _, data = self.publish_one(c, seed=2)
+        self.publish_one(c, seed=5)  # over budget: seed=2 demotes
+        assert c.counts["demote.cold"] >= 1
+        assert fp in c.cold_index()
+        # The demoted entry still serves — as a cold hit.
+        c2 = ProductCache(c.root, ram_bytes=1 << 20,
+                          cold_dir=c.cold_dir)
+        got = c2.get(fp)
+        assert got is not None and got[2] == "cold"
+        np.testing.assert_array_equal(got[1], data)
+
+    def test_rotted_cold_entry_is_evicted_not_promoted(self, tmp_path):
+        c = self.make(tmp_path)
+        fp, _, _ = self.publish_one(c, seed=3)
+        c._demote(fp)
+        with open(c.cold_data_path(fp), "r+b") as f:
+            f.seek(128)
+            f.write(b"\xff" * 16)
+        c2 = ProductCache(c.root, ram_bytes=1 << 20,
+                          cold_dir=c.cold_dir)
+        assert c2.get(fp) is None  # a miss, never garbage
+        assert not os.path.exists(c2.cold_data_path(fp))
+        assert not os.path.exists(c2.cold_meta_path(fp))
+        assert c2.counts["miss"] == 1
+
+    def test_ram_only_cache_ignores_cold_dir(self, tmp_path):
+        c = ProductCache(None, cold_dir=str(tmp_path / "cold"))
+        assert c.cold_dir is None
+        assert c.cold_index() == []
+
+    def test_hit_rate_counts_cold_hits(self, tmp_path):
+        c = self.make(tmp_path)
+        fp, _, _ = self.publish_one(c, seed=4)
+        c._demote(fp)
+        c2 = ProductCache(c.root, ram_bytes=1 << 20,
+                          cold_dir=c.cold_dir)
+        c2.get(fp)
+        c2.get("8" * 64)
+        assert c2.hit_rate == 0.5
