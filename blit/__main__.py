@@ -2207,6 +2207,41 @@ def _cmd_ingest_bench(args: argparse.Namespace) -> int:
             report["spans_on_s"] = on
             report["spans_off_s"] = off
             report["span_overhead"] = round(on / max(off, 1e-9) - 1.0, 4)
+        if args.history_compare:
+            # History+anomaly overhead A/B (ISSUE 20 acceptance: the
+            # durable store + baselines must cost <= 1% on an ingest
+            # leg) — the --spans-compare discipline: interleaved arms
+            # so slow drift doesn't masquerade as overhead, best wall
+            # per arm.  Each arm runs under a fast-ticking publisher;
+            # the ON arm's publisher also feeds tiered rings and
+            # scores anomaly baselines every tick.
+            import shutil as _shutil
+
+            from blit import monitor as _mon
+            from blit.config import SiteConfig as _SC
+
+            hist_td = os.path.join(td, "hist-ab")
+            hwalls = {True: [], False: []}
+            for _ in range(args.spans_reps):
+                for enabled in (True, False):
+                    if enabled:
+                        _shutil.rmtree(hist_td, ignore_errors=True)
+                        cfg = _SC(history_dir=hist_td,
+                                  history_raw_s=0.5)
+                    else:
+                        cfg = _SC(history_anomaly=False)
+                    p2 = _mon.MetricsPublisher(
+                        interval_s=0.05, spool_dir="", port=-1,
+                        config=cfg).start()
+                    try:
+                        hwalls[enabled].append(run(True)["wall_s"])
+                    finally:
+                        p2.close()
+            hon, hoff = min(hwalls[True]), min(hwalls[False])
+            report["history_on_s"] = hon
+            report["history_off_s"] = hoff
+            report["history_overhead"] = round(
+                hon / max(hoff, 1e-9) - 1.0, 4)
         if pub is not None:
             pub.tick()  # a final sample so short benches always spool one
             report["monitor"] = {"port": pub.port,
@@ -3404,7 +3439,10 @@ def _cmd_top(args: argparse.Namespace) -> int:
     ``--spool DIR`` tails the per-process monitor spool (merging a pod's
     processes through ``merge_fleet``); ``--url`` polls one publisher's
     ``/snapshot`` endpoint.  Refreshes every ``--interval`` seconds with
-    an ANSI clear; ``--once`` renders a single frame with no clear."""
+    an ANSI clear; ``--once`` renders a single frame with no clear.
+    ``--history DIR`` appends a sparkline panel per stored series from
+    a durable history store (ISSUE 20: the last N finest-tier
+    buckets)."""
     from blit import monitor, observability
 
     def fetch() -> str:
@@ -3418,7 +3456,14 @@ def _cmd_top(args: argparse.Namespace) -> int:
             samples = [sample]
         else:
             report, samples = monitor.merge_spool(args.spool)
-        return monitor.render_top(report, samples)
+        frame = monitor.render_top(report, samples)
+        if args.history:
+            from blit.history import HistoryStore, render_history_panel
+
+            store = HistoryStore(args.history, create=False)
+            frame += "\n" + render_history_panel(
+                store, buckets=args.history_buckets)
+        return frame
 
     if args.once:
         print(fetch())
@@ -3536,10 +3581,19 @@ def _cmd_requests(args: argparse.Namespace) -> int:
     requests were slow, and whose trace do I open" surface."""
     from blit import monitor
 
+    since = until = None
+    if args.since or args.until:
+        import time
+
+        from blit.history import parse_when
+
+        now = time.time()
+        since = parse_when(args.since, now) if args.since else None
+        until = parse_when(args.until, now) if args.until else None
     records = monitor.read_requests(args.spool, tail=args.tail)
     records = monitor.filter_requests(
         records, slow_ms=args.slow_ms, status=args.status,
-        client=args.client, role=args.role)
+        client=args.client, role=args.role, since=since, until=until)
     if args.aggregate:
         agg = monitor.aggregate_requests(records)
         print(json.dumps(agg) if args.json
@@ -3550,6 +3604,76 @@ def _cmd_requests(args: argparse.Namespace) -> int:
             print(json.dumps(r))
     else:
         print(monitor.render_requests(records))
+    return 0
+
+
+def _incident_dir(args: argparse.Namespace) -> str:
+    from blit.config import history_defaults
+
+    d = args.dir or history_defaults()["incident_dir"]
+    if not d:
+        raise SystemExit("no incident dir: pass --dir or set "
+                         "BLIT_INCIDENT_DIR")
+    return d
+
+
+def _cmd_incidents(args: argparse.Namespace) -> int:
+    """``blit incidents`` (ISSUE 20): list the self-contained forensics
+    bundles under the incident dir, oldest first."""
+    from blit.history import list_incidents, render_incidents
+
+    manifests = list_incidents(_incident_dir(args))
+    if args.json:
+        for m in manifests:
+            print(json.dumps(m))
+    else:
+        print(render_incidents(manifests))
+    return 0
+
+
+def _cmd_incident(args: argparse.Namespace) -> int:
+    """``blit incident show BUNDLE`` (ISSUE 20): render one bundle's
+    merged cross-source timeline — flight events, request records,
+    trace spans and the triggering alert, wall-clock aligned via the
+    stamped anchors.  ``--window`` narrows the timeline around the
+    page using the shared grammar (``15m``, ``2h``, an epoch pair)."""
+    import time
+
+    from blit.history import load_incident, render_incident, window_seconds
+
+    bundle = load_incident(args.bundle)
+    window = None
+    if args.window:
+        t = float((bundle.get("manifest") or {}).get("t", time.time()))
+        half = window_seconds(args.window)
+        window = (t - half, t + half / 4.0)
+    if args.json:
+        print(json.dumps(bundle))
+    else:
+        print(render_incident(bundle, window))
+    return 0
+
+
+def _cmd_slo_report(args: argparse.Namespace) -> int:
+    """``blit slo-report`` (ISSUE 20): attainment + error-budget spend
+    per objective over day/week windows, straight from a durable
+    history store — text for the operator, ``--json`` for CI (its
+    ``metrics`` block rides ``bench_metrics``/``blit bench-diff``, so
+    attainment gates like any bench scalar)."""
+    from blit.history import (
+        HistoryStore,
+        render_slo_report,
+        slo_report,
+        window_seconds,
+    )
+
+    store = HistoryStore(args.store, create=False)
+    doc = slo_report(store, window_s=window_seconds(args.window))
+    body = json.dumps(doc) if args.json else render_slo_report(doc)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write((json.dumps(doc) if args.json else body) + "\n")
+    print(body)
     return 0
 
 
@@ -3871,7 +3995,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="A/B the async leg with spans enabled vs disabled "
                          "and report the tracing overhead ratio")
     pg.add_argument("--spans-reps", type=int, default=3,
-                    help="interleaved repetitions per spans-compare arm")
+                    help="interleaved repetitions per spans-compare / "
+                         "history-compare arm")
+    pg.add_argument("--history-compare", action="store_true",
+                    help="A/B the async leg under a fast-ticking "
+                         "publisher with the history store + anomaly "
+                         "baselines armed vs bare, and report the "
+                         "history overhead ratio (ISSUE 20: <= 1%%)")
     pg.add_argument("--dedoppler", action="store_true",
                     help="also run the drift-search science leg over the "
                          "same recording and report drift-rate trials/s")
@@ -4320,6 +4450,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="render one frame (no ANSI clear) and exit")
     po.add_argument("--iterations", type=int, default=None,
                     help="stop after this many frames (tests/scripts)")
+    po.add_argument("--history", default=None, metavar="DIR",
+                    help="append per-series sparklines from this "
+                         "durable history store (BLIT_HISTORY_DIR; "
+                         "ISSUE 20)")
+    po.add_argument("--history-buckets", type=int, default=32,
+                    help="how many finest-tier buckets each sparkline "
+                         "spans")
     po.set_defaults(fn=_cmd_top)
 
     pd = sub.add_parser(
@@ -4396,6 +4533,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     pq.add_argument("--role", default=None,
                     choices=["door", "peer", "serve"],
                     help="keep one component role's records")
+    pq.add_argument("--since", default=None, metavar="WHEN",
+                    help="keep records at/after WHEN — an epoch, "
+                         "'15m'/'2h'/'1d'-style ago-windows, or 'now' "
+                         "(the `blit incident show` window grammar)")
+    pq.add_argument("--until", default=None, metavar="WHEN",
+                    help="keep records at/before WHEN (same grammar)")
     pq.add_argument("--aggregate", action="store_true",
                     help="print one summary (counts by status/tier, "
                          "p50/p99, slowest records w/ trace ids) "
@@ -4404,6 +4547,54 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="machine output: one JSON record per line "
                          "(or the compact aggregate)")
     pq.set_defaults(fn=_cmd_requests)
+
+    pin = sub.add_parser(
+        "incidents",
+        help="list the self-contained incident bundles under the "
+             "incident dir (BLIT_INCIDENT_DIR; ISSUE 20)",
+    )
+    pin.add_argument("--dir", default=None,
+                     help="incident bundle dir (default: "
+                          "BLIT_INCIDENT_DIR)")
+    pin.add_argument("--json", action="store_true",
+                     help="one manifest JSON per line")
+    pin.set_defaults(fn=_cmd_incidents)
+
+    pic = sub.add_parser(
+        "incident",
+        help="render one incident bundle's merged cross-source "
+             "timeline (ISSUE 20)",
+    )
+    pic.add_argument("action", choices=["show"],
+                     help="'show': render the bundle")
+    pic.add_argument("bundle",
+                     help="bundle directory (from `blit incidents`)")
+    pic.add_argument("--window", default=None, metavar="SPAN",
+                     help="narrow the timeline to SPAN around the page "
+                          "('15m', '2h', '1d' — the shared window "
+                          "grammar)")
+    pic.add_argument("--json", action="store_true",
+                     help="dump the loaded bundle as one JSON doc")
+    pic.set_defaults(fn=_cmd_incident)
+
+    psr = sub.add_parser(
+        "slo-report",
+        help="attainment + error-budget spend per objective over "
+             "day/week windows from a durable history store "
+             "(ISSUE 20; --json rides bench-diff)",
+    )
+    psr.add_argument("store",
+                     help="history store dir (BLIT_HISTORY_DIR)")
+    psr.add_argument("--window", default="1d",
+                     help="report window: '1d', '1w', seconds, ... "
+                          "(the shared window grammar; default 1d)")
+    psr.add_argument("--json", action="store_true",
+                     help="machine output (the 'metrics' block carries "
+                          "slo.<name>_attained for bench-diff gating)")
+    psr.add_argument("--out", default=None,
+                     help="also write the report to this file "
+                          "(CI artifact)")
+    psr.set_defaults(fn=_cmd_slo_report)
 
     args = p.parse_args(argv)
     return args.fn(args)

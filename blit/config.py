@@ -342,6 +342,48 @@ class SiteConfig:
     catalog_negative_max: int = 4096
     cache_cold_dir: Optional[str] = None
     backfill_bytes_per_s: float = 256e6
+    # History & incident forensics plane (blit/history.py; ISSUE 20).
+    # history_dir, when set, makes every MetricsPublisher tick fold its
+    # interval delta into an RRD-style tiered ring store (raw →
+    # minutes → hours buckets, fixed on-disk budget, oldest-bucket
+    # overwrite) that `blit top --history`, `blit slo-report` and the
+    # peer/door ``/history`` endpoints read.  The tier knobs fix each
+    # ring's bucket width and slot count (disk budget ≈ Σ slots ×
+    # history_slot_bytes, paid up front at creation).  history_anomaly
+    # layers a rolling median/MAD baseline over every stored series —
+    # a robust z-score past history_anomaly_z for
+    # history_anomaly_consecutive ticks pages through the flight-dump
+    # machinery (the creep static SLO thresholds miss);
+    # history_anomaly_overrides maps metric name → per-metric z.
+    # incident_dir enables one-artifact incident bundles on any page
+    # (SLO breach, anomaly, fleet eject, recover abort), rate-limited
+    # by incident_cooldown_s per incident kind, each bundling an
+    # incident_window_s history window.  Per-process overrides:
+    # BLIT_HISTORY_DIR / BLIT_HISTORY_RAW_S / BLIT_HISTORY_RAW_SLOTS /
+    # BLIT_HISTORY_MID_S / BLIT_HISTORY_MID_SLOTS / BLIT_HISTORY_SLOW_S
+    # / BLIT_HISTORY_SLOW_SLOTS / BLIT_HISTORY_SLOT_BYTES /
+    # BLIT_HISTORY_ANOMALY / BLIT_HISTORY_ANOMALY_Z /
+    # BLIT_HISTORY_ANOMALY_WINDOW / BLIT_HISTORY_ANOMALY_MIN_N /
+    # BLIT_HISTORY_ANOMALY_CONSEC / BLIT_HISTORY_SENSITIVITY /
+    # BLIT_INCIDENT_DIR / BLIT_INCIDENT_WINDOW / BLIT_INCIDENT_COOLDOWN
+    # (:func:`history_defaults`).
+    history_dir: Optional[str] = None
+    history_raw_s: float = 10.0
+    history_raw_slots: int = 720          # 2 h of raw buckets
+    history_mid_s: float = 60.0
+    history_mid_slots: int = 1440         # 1 day of minute buckets
+    history_slow_s: float = 3600.0
+    history_slow_slots: int = 336         # 2 weeks of hour buckets
+    history_slot_bytes: int = 16384
+    history_anomaly: bool = True
+    history_anomaly_z: float = 6.0
+    history_anomaly_window: int = 120
+    history_anomaly_min_n: int = 30
+    history_anomaly_consecutive: int = 3
+    history_anomaly_overrides: Optional[Dict[str, float]] = None
+    incident_dir: Optional[str] = None
+    incident_window_s: float = 900.0
+    incident_cooldown_s: float = 300.0
 
     def io_retry_policy(self):
         """The :class:`blit.faults.RetryPolicy` for worker-side file I/O —
@@ -727,6 +769,77 @@ def archive_defaults(config: SiteConfig = DEFAULT) -> Dict:
     if bps is not None and bps <= 0:
         bps = None  # unpaced (the scrubber's "no budget" encoding)
     return {"cold_dir": cold, "backfill_bytes_per_s": bps}
+
+
+def history_defaults(config: SiteConfig = DEFAULT) -> Dict:
+    """The effective history/forensics knob set (ISSUE 20): ``config``'s
+    values with per-process ``BLIT_HISTORY_*`` / ``BLIT_INCIDENT_*``
+    environment overrides applied — the :func:`stream_defaults` pattern,
+    resolved when a :class:`blit.history.HistoryStore` /
+    :class:`blit.history.AnomalyDetector` / bundler is constructed.
+    ``enabled`` is derived: the store is on only when a dir is
+    configured; ``anomaly`` is additionally gated by its kill switch
+    (``BLIT_HISTORY_ANOMALY=0`` silences the baseline pager without
+    touching the store).  ``BLIT_HISTORY_SENSITIVITY`` is a
+    ``metric=z,metric=z`` list of per-metric z overrides folded over
+    ``config.history_anomaly_overrides``."""
+
+    def opt_dir(env: str, fallback: Optional[str]) -> Optional[str]:
+        v = os.environ.get(env)
+        if v is None:
+            return fallback
+        return v or None
+
+    d = opt_dir("BLIT_HISTORY_DIR", config.history_dir)
+    inc = opt_dir("BLIT_INCIDENT_DIR", config.incident_dir)
+    an = os.environ.get("BLIT_HISTORY_ANOMALY")
+    anomaly = (config.history_anomaly if an is None
+               else an.lower() not in ("", "0", "false", "off"))
+    overrides: Dict[str, float] = dict(config.history_anomaly_overrides
+                                       or {})
+    for part in os.environ.get("BLIT_HISTORY_SENSITIVITY", "").split(","):
+        part = part.strip()
+        if not part or "=" not in part:
+            continue
+        k, _, v = part.partition("=")
+        try:
+            overrides[k.strip()] = float(v)
+        except ValueError:
+            continue
+    return {
+        "dir": d,
+        "raw_s": float(os.environ.get(
+            "BLIT_HISTORY_RAW_S", config.history_raw_s)),
+        "raw_slots": int(os.environ.get(
+            "BLIT_HISTORY_RAW_SLOTS", config.history_raw_slots)),
+        "mid_s": float(os.environ.get(
+            "BLIT_HISTORY_MID_S", config.history_mid_s)),
+        "mid_slots": int(os.environ.get(
+            "BLIT_HISTORY_MID_SLOTS", config.history_mid_slots)),
+        "slow_s": float(os.environ.get(
+            "BLIT_HISTORY_SLOW_S", config.history_slow_s)),
+        "slow_slots": int(os.environ.get(
+            "BLIT_HISTORY_SLOW_SLOTS", config.history_slow_slots)),
+        "slot_bytes": int(os.environ.get(
+            "BLIT_HISTORY_SLOT_BYTES", config.history_slot_bytes)),
+        "anomaly": anomaly,
+        "anomaly_z": float(os.environ.get(
+            "BLIT_HISTORY_ANOMALY_Z", config.history_anomaly_z)),
+        "anomaly_window": int(os.environ.get(
+            "BLIT_HISTORY_ANOMALY_WINDOW", config.history_anomaly_window)),
+        "anomaly_min_n": int(os.environ.get(
+            "BLIT_HISTORY_ANOMALY_MIN_N", config.history_anomaly_min_n)),
+        "anomaly_consecutive": int(os.environ.get(
+            "BLIT_HISTORY_ANOMALY_CONSEC",
+            config.history_anomaly_consecutive)),
+        "anomaly_overrides": overrides,
+        "incident_dir": inc,
+        "incident_window_s": float(os.environ.get(
+            "BLIT_INCIDENT_WINDOW", config.incident_window_s)),
+        "incident_cooldown_s": float(os.environ.get(
+            "BLIT_INCIDENT_COOLDOWN", config.incident_cooldown_s)),
+        "enabled": d is not None,
+    }
 
 
 def default_window_frames(nfft: int) -> int:

@@ -66,7 +66,13 @@ import time
 from dataclasses import dataclass
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
-from blit.config import DEFAULT, SiteConfig, monitor_defaults, slo_defaults
+from blit.config import (
+    DEFAULT,
+    SiteConfig,
+    history_defaults,
+    monitor_defaults,
+    slo_defaults,
+)
 from blit.observability import (
     HistogramStats,
     Timeline,
@@ -76,6 +82,7 @@ from blit.observability import (
     merge_fleet,
     process_timeline,
     render_prometheus,
+    wall_anchor,
 )
 
 log = logging.getLogger("blit.monitor")
@@ -191,6 +198,11 @@ class BurnRateEvaluator:
         self._shed_hooks: List[Callable[[float], None]] = []
         self._shed = 0.0
         self.alerts: List[Dict] = []
+        # The last round's per-objective (bad, total) observations —
+        # the history store's SLO burn feed (blit.history folds them
+        # into bucket records so slo-report sums the same cut the live
+        # evaluator made).
+        self.last_obs: Dict[str, Tuple[int, int]] = {}
 
     @classmethod
     def for_config(cls, config: SiteConfig = DEFAULT, **kw
@@ -247,6 +259,7 @@ class BurnRateEvaluator:
             ring = self._rings[o.name]
             ring.append((bad, total))
             del ring[:-self.slow_window]
+            self.last_obs[o.name] = (bad, total)
             bf = self.burn(o.name, self.fast_window)
             bs = self.burn(o.name, self.slow_window)
             breach = bf >= self.fast_burn and bs >= self.slow_burn
@@ -256,7 +269,8 @@ class BurnRateEvaluator:
             if not breach:
                 continue
             breached_any = True
-            alert = {"t": self.clock(), "objective": o.name,
+            alert = {"t": self.clock(), "class": "slo",
+                     "objective": o.name,
                      "kind": o.kind, "metric": o.metric,
                      "threshold": o.threshold, "burn_fast": round(bf, 3),
                      "burn_slow": round(bs, 3), "bad": bad,
@@ -465,6 +479,33 @@ class MetricsPublisher:
                 slow_window=config.slo_slow_window,
                 fast_burn=config.slo_fast_burn,
                 slow_burn=config.slo_slow_burn, clock=clock)
+        # History & forensics plane (ISSUE 20): a durable tiered store
+        # fed per tick, a median/MAD anomaly baseline scored per tick,
+        # and the incident bundler behind every page.  All lazy and all
+        # optional — with BLIT_HISTORY_DIR unset the tick path pays one
+        # dict lookup and three Nones.
+        self._config = config
+        self.history = None
+        self.anomaly = None
+        self._bundler = None
+        hd = history_defaults(config)
+        if hd["enabled"]:
+            from blit import history as _history
+
+            try:
+                self.history = _history.HistoryStore(
+                    hd["dir"], config=config, clock=clock)
+            except (OSError, ValueError):
+                log.warning("history store unavailable", exc_info=True)
+        if hd["anomaly"] and (hd["enabled"] or hd["incident_dir"]):
+            from blit import history as _history
+
+            self.anomaly = _history.AnomalyDetector.for_config(
+                config, clock=clock)
+        if hd["incident_dir"]:
+            from blit import history as _history
+
+            self._bundler = _history.incident_bundler(config)
         self.seq = 0
         self.last_sample: Optional[Dict] = None
         self._last_state: Optional[Dict] = None
@@ -548,15 +589,46 @@ class MetricsPublisher:
                 self._own.gauge("mesh.ici_gbps", ici / interval / 1e9)
                 merged.gauge("mesh.ici_gbps", ici / interval / 1e9)
             alerts = self.slo.observe(delta, interval)
+            now = self.clock()
+            anomaly_state: Dict[str, Dict] = {}
+            if self.anomaly is not None:
+                from blit import history as _history
+
+                gauges_now = {k: g.last
+                              for k, g in merged.gauges.items() if g.n}
+                alerts = alerts + self.anomaly.observe(
+                    _history.series_values(delta, gauges_now), now)
+                anomaly_state = self.anomaly.report()
+            if self.history is not None:
+                try:
+                    self.history.append(
+                        now, interval, delta,
+                        gauges={k: g.last
+                                for k, g in merged.gauges.items() if g.n},
+                        burn=dict(self.slo.last_obs))
+                except Exception:  # noqa: BLE001 — durability is best-
+                    log.warning("history append failed", exc_info=True)
+            if self._bundler is not None:
+                for alert in alerts:
+                    kind = (f"slo:{alert['objective']}"
+                            if alert.get("objective")
+                            else f"anomaly:{alert.get('metric', '?')}")
+                    self._bundler.snapshot(
+                        kind,
+                        f"page: {kind} "
+                        f"(flight={alert.get('flight_dump', '-')})",
+                        alert=alert, publisher=self, timeline=merged,
+                        history=self.history)
             self._last_state = merged.state()
             from blit import faults
 
             sample = {
-                "t": self.clock(),
+                "t": now,
                 "seq": self.seq,
                 "host": hostname(),
                 "pid": os.getpid(),
                 "worker": 0,
+                "anchor": wall_anchor(),
                 "interval_s": round(interval, 6),
                 "timeline": self._last_state,
                 "faults": faults.counters(),
@@ -576,6 +648,8 @@ class MetricsPublisher:
                 "slo": self.slo.report(),
                 "alerts": alerts,
             }
+            if anomaly_state:
+                sample["anomaly"] = anomaly_state
             if self.spans:
                 from blit import observability
 
@@ -618,6 +692,9 @@ class MetricsPublisher:
         breached = self.slo.breached()
         for name in breached:
             reasons.append(f"slo-fast-burn:{name}")
+        if self.anomaly is not None:
+            for metric in self.anomaly.breached():
+                reasons.append(f"anomaly:{metric}")
         try:
             # Lazy import (monitor's import discipline): the pool module
             # is stdlib + blit.faults/observability/config, never jax.
@@ -695,6 +772,8 @@ class MetricsPublisher:
             with contextlib.suppress(OSError):
                 self._spool_f.close()
             self._spool_f = None
+        if self.history is not None:
+            self.history.close()
 
     def __enter__(self):
         return self.start()
@@ -777,7 +856,11 @@ def ensure_publisher(config: SiteConfig = DEFAULT
     with _PUB_LOCK:
         if _PUB is not None:
             return _PUB
-    if not monitor_defaults(config)["enabled"]:
+    # BLIT_HISTORY_DIR alone also arms the loop (ISSUE 20): the
+    # durable store is fed by ticks, so a history-only config still
+    # needs the publisher running even with no spool and no port.
+    if not (monitor_defaults(config)["enabled"]
+            or history_defaults(config)["enabled"]):
         return None
     with _PUB_LOCK:
         if _PUB is None:
@@ -861,11 +944,17 @@ _SPOOL_TAIL_BYTES = 2 << 20
 
 def read_spool(spool_dir: str, tail: int = 1) -> List[Dict]:
     """The newest ``tail`` parseable samples from every per-process
-    spool file, flattened oldest→newest per file (a torn trailing line
-    — a process mid-write — is skipped).  Reads only the last
+    spool file, flattened oldest→newest per file.  Reads only the last
     ``_SPOOL_TAIL_BYTES`` of each file, so a frame over a multi-hour
-    spool costs the same as over a fresh one."""
+    spool costs the same as over a fresh one.
+
+    Torn-tail hardening (ISSUE 20 satellite): a publisher SIGKILLed
+    mid-``write`` leaves a truncated trailing line — it HEALS (skipped)
+    and COUNTS (``monitor.torn_lines`` on the process timeline), the
+    PR 19 backfill-ledger rule, so ``blit top`` keeps rendering while
+    the damage stays visible."""
     samples = []
+    torn = 0
     for path in sorted(glob.glob(os.path.join(spool_dir, "*.jsonl"))):
         try:
             with open(path, "rb") as f:
@@ -886,10 +975,13 @@ def read_spool(spool_dir: str, tail: int = 1) -> List[Dict]:
             try:
                 got.append(json.loads(line))
             except ValueError:
+                torn += 1
                 continue
             if len(got) >= tail:
                 break
         samples.extend(reversed(got))
+    if torn:
+        process_timeline().count("monitor.torn_lines", torn)
     return samples
 
 
@@ -1117,8 +1209,9 @@ def read_requests(src: str, tail: Optional[int] = None) -> List[Dict]:
     """Access records from a request-log spool: ``src`` is a directory
     (every ``requests-*.jsonl`` member, rotations included), a single
     ``.jsonl`` file, or a rotated member.  Records come back
-    time-ordered; a torn trailing line (a process mid-write) is
-    skipped.  ``tail`` keeps only the newest N."""
+    time-ordered; a torn line (a process SIGKILLed mid-write) HEALS
+    (skipped) and COUNTS (``monitor.torn_lines``) — the spool-reader
+    rule.  ``tail`` keeps only the newest N."""
     paths: List[str] = []
     if os.path.isdir(src):
         paths = sorted(glob.glob(os.path.join(src, "requests-*.jsonl*")))
@@ -1127,6 +1220,7 @@ def read_requests(src: str, tail: Optional[int] = None) -> List[Dict]:
     else:
         paths = [src]
     records: List[Dict] = []
+    torn = 0
     for path in paths:
         try:
             with open(path) as f:
@@ -1137,11 +1231,14 @@ def read_requests(src: str, tail: Optional[int] = None) -> List[Dict]:
                     try:
                         doc = json.loads(line)
                     except ValueError:
+                        torn += 1
                         continue
                     if isinstance(doc, dict):
                         records.append(doc)
         except OSError:
             continue
+    if torn:
+        process_timeline().count("monitor.torn_lines", torn)
     records.sort(key=lambda r: r.get("t", 0.0))
     if tail is not None:
         records = records[-max(0, int(tail)):]
@@ -1152,13 +1249,22 @@ def filter_requests(records: Iterable[Dict], *,
                     slow_ms: Optional[float] = None,
                     status: Optional[str] = None,
                     client: Optional[str] = None,
-                    role: Optional[str] = None) -> List[Dict]:
+                    role: Optional[str] = None,
+                    since: Optional[float] = None,
+                    until: Optional[float] = None) -> List[Dict]:
     """The ``blit requests`` filter surface: keep records at least
     ``slow_ms`` slow, matching a status (name like ``overloaded`` or
-    HTTP code like ``503``), a client, a role (door/peer/serve)."""
+    HTTP code like ``503``), a client, a role (door/peer/serve), and/or
+    inside a ``[since, until]`` epoch window (``blit requests
+    --since/--until`` parse the shared window grammar —
+    :func:`blit.history.parse_when` — into these)."""
     out = []
     for r in records:
         if slow_ms is not None and r.get("duration_s", 0.0) * 1e3 < slow_ms:
+            continue
+        if since is not None and float(r.get("t", 0.0)) < since:
+            continue
+        if until is not None and float(r.get("t", 0.0)) > until:
             continue
         if status is not None and not (
                 str(r.get("status")) == status
@@ -1408,7 +1514,10 @@ def bench_metrics(doc: Dict) -> Dict[str, float]:
     for serve-bench records (``serve-bench --archive-day``, ISSUE 16)
     the flat ``metrics`` dict — fleet hit rate, wire GB/s, and the
     request/serialize latency quantiles (``*_pNN_s``, which compare
-    lower-is-better)."""
+    lower-is-better).  ``blit slo-report --json`` documents ride the
+    same ``metrics`` branch (``slo.<name>_attained`` matches
+    ``_attained$``), so ``blit bench-diff`` gates attainment like any
+    other bench scalar."""
     out: Dict[str, float] = {}
 
     def num(v) -> Optional[float]:

@@ -45,6 +45,25 @@ log = logging.getLogger("blit.observability")
 _HOSTNAME: Optional[str] = None
 
 
+# Captured once at import: the process's (epoch, monotonic) clock pair.
+# Monotonic readings from different processes are incomparable (each
+# starts at an arbitrary origin); shipping this anchor beside every
+# spool sample, span batch and flight dump lets a forensics reader
+# (blit/history.py incident bundles) project any monotonic-relative
+# reading onto shared wall-clock time — and quantifies inter-host skew
+# when two anchors disagree about "now" (ISSUE 20 satellite).
+_WALL_ANCHOR = {"epoch": round(time.time(), 6),
+                "mono": round(time.monotonic(), 6)}
+
+
+def wall_anchor() -> Dict[str, float]:
+    """This process's wall-clock anchor: one ``{"epoch", "mono"}`` pair
+    captured at import.  ``epoch - mono`` is the process's monotonic
+    origin in wall time; two processes' timelines align by comparing
+    origins instead of trusting their skewed starts."""
+    return dict(_WALL_ANCHOR)
+
+
 def hostname() -> str:
     """This process's host name (cached — span creation must stay cheap)."""
     global _HOSTNAME
@@ -905,6 +924,7 @@ class FlightRecorder:
                 "host": hostname(),
                 "pid": os.getpid(),
                 "worker": _WORKER,
+                "anchor": wall_anchor(),
                 "events": self.events(),
                 "faults": faults.counters(),
                 "timeline": process_timeline().report(),
@@ -1032,6 +1052,14 @@ def render_flight_dump(doc: Dict, tail: int = 40) -> str:
     lines.append(f"where  : {doc.get('host', '?')}/w{doc.get('worker', 0)} "
                  f"pid {doc.get('pid', '?')}")
     lines.append(f"when   : {when} UTC")
+    anchor = doc.get("anchor") or {}
+    if anchor:
+        # epoch - mono = the dumping process's monotonic origin on the
+        # wall clock — what cross-process bundle timelines align on.
+        origin = anchor.get("epoch", 0.0) - anchor.get("mono", 0.0)
+        lines.append(f"anchor : epoch={anchor.get('epoch')} "
+                     f"mono={anchor.get('mono')} "
+                     f"(mono origin {origin:.3f})")
     if doc.get("trace"):
         # The ambient trace at dump time (ISSUE 15): follow it into the
         # stitched fleet trace (`blit trace-view --fleet ... --trace`).
@@ -1271,6 +1299,7 @@ def telemetry_snapshot(reset: bool = False, spans: bool = True) -> Dict:
         "host": hostname(),
         "pid": os.getpid(),
         "worker": _WORKER,
+        "anchor": wall_anchor(),
         "timeline": _PROCESS_TL.state(),
         "faults": faults.counters(),
         "spans": _TRACER.span_dicts() if spans else [],

@@ -697,6 +697,21 @@ class ScanSupervisor:
             f"supervised scan peer proc{proc} {why} "
             f"(detected after {detect_s:.2f}s); aborting the attempt "
             f"for degrade-and-resume")
+        # A supervised-peer death is an incident (ISSUE 20): snapshot
+        # the forensics bundle while the evidence (flight ring, request
+        # log, history window) is still warm.
+        try:
+            from blit.history import maybe_incident
+
+            maybe_incident(
+                "recover",
+                f"supervised scan peer proc{proc} {why} "
+                f"(detected after {detect_s:.2f}s)",
+                alert={"t": time.time(), "class": "recover",
+                       "proc": proc, "why": why,
+                       "detect_s": round(float(detect_s), 4), "rc": rc})
+        except Exception:  # noqa: BLE001 — paging must not break recover
+            log.warning("recover incident bundle failed", exc_info=True)
         return {"proc": proc, "why": why,
                 "detect_s": round(float(detect_s), 4), "rc": rc}
 

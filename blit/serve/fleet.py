@@ -364,6 +364,20 @@ class FleetFrontDoor:
         self.timeline.observe("fleet.detect_s", p.watch.age_s())
         flight_recorder().event("fleet", "eject", peer=p.name,
                                 reason=reason)
+        # An eject IS an incident: one self-contained forensics bundle
+        # (ISSUE 20) when BLIT_INCIDENT_DIR arms the bundler — the
+        # door's timeline + recent requests/spans around the kill.
+        try:
+            from blit.history import maybe_incident
+
+            maybe_incident(
+                "fleet-eject",
+                f"fleet ejected peer {p.name}: {reason}",
+                alert={"t": time.time(), "class": "fleet",
+                       "peer": p.name, "reason": reason},
+                timeline=self.timeline)
+        except Exception:  # noqa: BLE001 — paging must not break eject
+            log.warning("eject incident bundle failed", exc_info=True)
         log.warning("fleet: ejected peer %s (%s); %d peer(s) remain",
                     p.name, reason, len(self.ring))
 
@@ -963,6 +977,42 @@ class FleetFrontDoor:
             "hists": {k: v for k, v in (rep.get("hists") or {}).items()
                       if k in FLEET_HISTS},
         }
+
+    def history(self, since: float, until: float, *,
+                tier: Optional[str] = None) -> Dict:
+        """Fleet-wide history range query (ISSUE 20): fan ``GET
+        /history`` out to every in-ring peer and fold the answers with
+        :func:`blit.history.merge_buckets` — the same commutative
+        hist-state/stage/burn fold the stores use locally, so the
+        merged series read exactly as one peer's would.  Peers that
+        fail or answer without a store are skipped and named."""
+        from blit.history import merge_buckets
+
+        q = f"/history?since={since}&until={until}"
+        if tier:
+            q += f"&tier={tier}"
+        lists: List[List[Dict]] = []
+        answered: List[str] = []
+        skipped: List[str] = []
+        with self._lock:
+            peers = [(n, p) for n, p in sorted(self._peers.items())
+                     if p.in_ring]
+        for name, p in peers:
+            try:
+                status, _, body = http_json("GET", p.url, q,
+                                            timeout=10.0, pool=self.pool)
+            except OSError:
+                skipped.append(name)
+                continue
+            if status == 200 and isinstance(body, dict) \
+                    and body.get("enabled"):
+                lists.append(body.get("buckets") or [])
+                answered.append(name)
+            else:
+                skipped.append(name)
+        return {"t0": since, "t1": until, "peers": answered,
+                "skipped": skipped,
+                "buckets": merge_buckets(lists)}
 
     def metrics_prometheus(self, openmetrics: bool = False) -> str:
         snapshot = {"host": hostname(), "pid": os.getpid(), "worker": 0,

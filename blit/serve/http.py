@@ -597,6 +597,38 @@ def _json_resp(status: int, doc: Dict,
     return status, json.dumps(doc), "application/json", headers or {}
 
 
+def history_query(path: str) -> Tuple[float, float, Optional[str]]:
+    """Parse a ``GET /history`` query string → ``(since, until, tier)``
+    epochs.  ``since``/``until`` accept the shared window grammar
+    (:func:`blit.history.parse_when`: epoch, ``"15m"``-style
+    ago-windows, ``"now"``); default: the last hour."""
+    from urllib.parse import parse_qs, urlsplit
+
+    from blit.history import parse_when
+
+    q = parse_qs(urlsplit(path).query)
+    now = time.time()
+    until = parse_when(q["until"][0], now) if q.get("until") else now
+    since = (parse_when(q["since"][0], now) if q.get("since")
+             else until - 3600.0)
+    tier = q["tier"][0] if q.get("tier") else None
+    return since, until, tier
+
+
+def _history_doc(pub, path: str) -> Dict:
+    """The peer ``GET /history`` body: this process's bucket records
+    over the queried window, in the fleet-merge wire shape (the door
+    folds peers' answers with :func:`blit.history.merge_buckets`)."""
+    since, until, tier = history_query(path)
+    store = getattr(pub, "history", None)
+    doc = {"t0": since, "t1": until, "enabled": store is not None,
+           "host": observability.hostname(), "buckets": [], "metrics": []}
+    if store is not None:
+        doc["buckets"] = store.buckets(since, until, tier=tier)
+        doc["metrics"] = store.metrics(window_s=max(60.0, until - since))
+    return doc
+
+
 def snapshot_with(timeline, name: Optional[str] = None) -> Dict:
     """This process's telemetry-snapshot wire document WITH spans — the
     ``/snapshot`` body both the peer and the front door serve
@@ -679,9 +711,21 @@ class PeerServer:
         from blit.monitor import MetricsPublisher
 
         # port=-1 / spool_dir="": explicitly OFF — this server IS the
-        # peer's endpoint; the publisher only renders its bodies.
-        self._pub = MetricsPublisher(interval_s=3600.0, spool_dir="",
-                                     port=-1, timeline=service.timeline)
+        # peer's endpoint; the publisher only renders its bodies.  With
+        # the history plane armed (BLIT_HISTORY_DIR), the publisher DOES
+        # tick on the monitor interval so the peer's /history rings fill
+        # and its anomaly baselines score (ISSUE 20) — still no second
+        # HTTP endpoint and no spool.
+        from blit.config import history_defaults, monitor_defaults
+
+        history_on = bool(history_defaults(config)["enabled"])
+        self._pub = MetricsPublisher(
+            interval_s=(monitor_defaults(config)["interval_s"]
+                        if history_on else 3600.0),
+            spool_dir="", port=-1, timeline=service.timeline,
+            config=config)
+        if history_on:
+            self._pub.start()
         self._server = _make_server(self._route, port, host)
         self.port = self._server.server_address[1]
         # The advertised URL: loopback when bound there, else the
@@ -725,6 +769,11 @@ class PeerServer:
             # histogram exemplars), in the telemetry-snapshot wire
             # shape — `blit trace-view --fleet <url>` stitches these.
             return _json_resp(200, self.snapshot())
+        if method == "GET" and path.startswith("/history"):
+            # The durable-store range query (ISSUE 20): bucket records
+            # over ?since/?until — empty (enabled=false) until
+            # BLIT_HISTORY_DIR arms the plane.
+            return _json_resp(200, _history_doc(self._pub, path))
         if method == "POST" and path.startswith("/product"):
             return self._handle_product(doc or {}, headers or {})
         if method == "POST" and path.startswith("/warm"):
@@ -1034,6 +1083,13 @@ class FrontDoorServer:
         if method == "GET" and path.startswith("/snapshot"):
             return _json_resp(200, snapshot_with(self.door.timeline,
                                                  "door"))
+        if method == "GET" and path.startswith("/history"):
+            # Fleet-wide history: fan the range query out to every
+            # live peer and fold the answers (ISSUE 20) — one query
+            # surface for "what did the FLEET look like last Tuesday".
+            since, until, tier = history_query(path)
+            return _json_resp(200, self.door.history(since, until,
+                                                     tier=tier))
         if method == "POST" and path.startswith("/product"):
             # An external client's trace continues through the door
             # (ISSUE 15): activate its context so the door's

@@ -878,6 +878,16 @@ class ProductService:
             self._scrubber.close()
             self._scrubber = None
         if self._publisher is not None:
+            if self._publisher.history is not None:
+                # One last sample BEFORE this timeline leaves the watch
+                # set (ISSUE 20): the tail of the service's activity —
+                # everything since the previous interval tick — lands
+                # in the durable history rings instead of vanishing.
+                try:
+                    self._publisher.tick()
+                except Exception:  # noqa: BLE001 — teardown must finish
+                    log.warning("final history tick failed",
+                                exc_info=True)
             self._publisher.unwatch(self.timeline)
             self._publisher.slo.detach_scheduler(self.scheduler)
             self._publisher = None
